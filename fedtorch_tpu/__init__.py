@@ -1,0 +1,25 @@
+"""fedtorch_tpu — a TPU-native federated-learning & local-SGD framework.
+
+A ground-up JAX/XLA rebuild of the capabilities of MLOPTPSU/FedTorch
+(reference mounted at /root/reference): the FedAvg/FedProx/SCAFFOLD/
+FedGATE/FedCOMGATE/Qsparse/FedAdam/APFL/PerFedMe/PerFedAvg/AFL/DRFA/qFFL
+algorithm zoo, non-IID data partitioning, the model zoo, LR scheduling,
+compression, and checkpointing — designed TPU-first:
+
+* clients live on a leading pytree axis laid out ``[devices,
+  clients_per_device, ...]`` over a ``jax.sharding.Mesh``;
+* local-SGD inner loops are ``lax.scan``s inside one jitted round program;
+* the reference's MPI gather/broadcast star becomes masked ``psum``-style
+  collectives over ICI/DCN;
+* compression (int8/16 affine quantization, fixed-k top-k with error
+  feedback) is an in-graph transform.
+
+See SURVEY.md for the blueprint and file:line parity citations.
+"""
+
+__version__ = "0.1.0"
+
+from fedtorch_tpu.config import (  # noqa: F401
+    CheckpointConfig, DataConfig, ExperimentConfig, FederatedConfig,
+    LRConfig, MeshConfig, ModelConfig, OptimConfig, TrainConfig,
+)

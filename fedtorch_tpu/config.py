@@ -1,0 +1,304 @@
+"""Typed, immutable experiment configuration.
+
+Capability parity with the reference flag system
+(``/root/reference/fedtorch/parameters.py:12-260``), redesigned for a
+TPU/JAX build:
+
+* Static configuration is a frozen, hashable dataclass tree, so it can be
+  passed as a ``static_argnum`` through ``jax.jit`` boundaries. The
+  reference instead threads a mutable ``argparse.Namespace`` everywhere and
+  writes runtime values back into it (``SURVEY.md`` §5.6); here runtime
+  state lives in explicit pytrees (see ``fedtorch_tpu.core.state``).
+* Post-parse derivations/validations from ``parameters.py:245-259``
+  (federated epoch count, AFL coercion, qsparse->compressed, quantize xor
+  compress, personalization->fed_personal) are reproduced in
+  :meth:`ExperimentConfig.finalize`.
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+# Algorithms that keep a second, personalized model per client
+# (ref: parameters.py:257-259).
+PERSONALIZED_ALGORITHMS = ("apfl", "perfedme", "perfedavg")
+
+FEDERATED_ALGORITHMS = (
+    "fedavg", "scaffold", "fedprox", "fedgate", "fedadam", "apfl", "afl",
+    "perfedavg", "qsparse", "perfedme", "qffl",
+)
+
+DATASETS = (
+    "cifar10", "cifar100", "mnist", "fashion_mnist", "emnist", "emnist_full",
+    "synthetic", "shakespeare", "adult", "epsilon", "MSD", "higgs", "rcv1",
+    "stl10",
+)
+
+
+@dataclass(frozen=True)
+class DataConfig:
+    """Dataset & partitioning knobs (ref: parameters.py:23-37, 41-66)."""
+    dataset: str = "cifar10"
+    data_dir: str = "./data/"
+    partition_data: bool = True
+    # Non-IID partitioning scheme (ref: partition.py:106-220).
+    iid: bool = True
+    num_class_per_client: int = 1
+    unbalanced: bool = False
+    dirichlet: bool = False
+    dirichlet_alpha: float = 0.1  # hard-coded in the reference partitioner
+    # Synthetic dataset heterogeneity (ref: parameters.py:33-36).
+    synthetic_alpha: float = 0.0
+    synthetic_beta: float = 0.0
+    synthetic_dim: int = 60
+    synthetic_num_classes: int = 10
+    synthetic_samples_per_client: int = 500
+    synthetic_regression: bool = False
+    # Adult sensitive-feature split (ref: parameters.py:37).
+    sensitive_feature: int = 9
+    # Batching (ref: parameters.py:131-141).
+    batch_size: int = 50
+    growing_batch_size: bool = False
+    base_batch_size: Optional[int] = None
+    max_batch_size: int = 0
+    reshuffle_per_epoch: bool = False
+    # Personalization val split sizes mirror dataset.py:168-211.
+    val_fraction: float = 0.2
+
+
+@dataclass(frozen=True)
+class FederatedConfig:
+    """Federated-mode knobs (ref: parameters.py:40-110)."""
+    federated: bool = False
+    num_clients: int = 10  # world size in the reference's MPI mode
+    num_comms: int = 100
+    online_client_rate: float = 0.1
+    sync_type: str = "epoch"  # 'epoch' | 'local_step'
+    num_epochs_per_comm: int = 1
+    algorithm: str = "fedavg"  # --federated_type
+    # Personalization.
+    personal: bool = False          # --fed_personal
+    personal_alpha: float = 0.5     # APFL mixing alpha
+    adaptive_alpha: bool = False    # optimize APFL alpha on the fly
+    personal_test: bool = False
+    # Server adaptivity (FedAdam, arXiv:2003.00295).
+    fedadam_beta: float = 0.9
+    fedadam_tau: float = 0.1
+    # Wire compression (ref: parameters.py:81-89).
+    quantized: bool = False
+    quantized_bits: int = 8
+    compressed: bool = False
+    compressed_ratio: float = 1.0
+    # DRFA wrapper (ref: parameters.py:90-97).
+    drfa: bool = False
+    drfa_gamma: float = 0.1
+    # Per-algorithm scalars.
+    perfedavg_beta: float = 0.001
+    fedprox_mu: float = 0.002
+    perfedme_lambda: float = 15.0
+    qffl_q: float = 0.0
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Architecture knobs (ref: parameters.py:113-115, 180-194)."""
+    arch: str = "mlp"
+    drop_rate: float = 0.0
+    # Normalization: 'bn' matches the reference; 'gn' is the TPU-friendly
+    # stateless default (no running stats to carry through collectives).
+    norm: str = "bn"
+    densenet_growth_rate: int = 12
+    densenet_bc_mode: bool = False
+    densenet_compression: float = 0.5
+    wideresnet_widen_factor: int = 4
+    mlp_num_layers: int = 2
+    mlp_hidden_size: int = 500
+    rnn_seq_len: int = 50
+    rnn_hidden_size: int = 50
+    vocab_size: int = 86
+    pretrained: bool = False
+    # 'robust_*' archs learn an adversarial input-noise parameter.
+    robust_noise_ascent_lr: float = 0.1
+
+
+@dataclass(frozen=True)
+class OptimConfig:
+    """Optimizer & momentum scheme (ref: parameters.py:168-183)."""
+    optimizer: str = "sgd"  # 'sgd' | 'adam'
+    lr: float = 0.01
+    in_momentum: bool = False
+    in_momentum_factor: float = 0.9
+    out_momentum: bool = False
+    # Default derived as 1 - 1/n in the reference (optimizer.py:6-31).
+    out_momentum_factor: Optional[float] = None
+    use_nesterov: bool = False
+    dampening: float = 0.0
+    weight_decay: float = 5e-4
+    correct_wd: bool = False  # AdamW decoupled weight decay switch
+    lr_scale_at_sync: float = 1.0
+    adam_beta1: float = 0.9
+    adam_beta2: float = 0.999
+    adam_eps: float = 1e-8
+
+
+@dataclass(frozen=True)
+class LRConfig:
+    """LR schedule compiler inputs (ref: parameters.py:144-166)."""
+    schedule_scheme: Optional[str] = None  # strict|custom_one_cycle|custom_multistep|custom_convex_decay
+    lr_change_epochs: Optional[str] = None
+    lr_fields: Optional[str] = None
+    lr_scale_indicators: Optional[str] = None
+    scaleup: bool = False
+    scaleup_type: str = "linear"
+    scaleup_factor: Optional[float] = None
+    warmup: bool = False
+    warmup_epochs: int = 5
+    decay: float = 10.0
+    onecycle_low: float = 0.15
+    onecycle_high: float = 3.0
+    onecycle_extra_low: float = 0.0015
+    onecycle_num_epoch: int = 46
+    gamma: Optional[float] = None
+    mu: Optional[float] = None
+    alpha: Optional[float] = None
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    """Stop criteria & local-step schedule (ref: parameters.py:118-130)."""
+    stop_criteria: str = "epoch"  # 'epoch' | 'iteration'
+    num_epochs: Optional[int] = None
+    num_iterations: Optional[int] = None
+    local_step: int = 1
+    local_step_warmup_per_interval: bool = False
+    local_step_warmup_type: Optional[str] = None  # 'exp' | 'linear' | constant
+    local_step_warmup_period: Optional[int] = None
+    turn_on_local_step_from: Optional[int] = None
+    turn_off_local_step_from: Optional[int] = None
+    avg_model: bool = True
+    manual_seed: int = 6
+    evaluate: bool = False
+    eval_freq: int = 1
+    summary_freq: int = 10
+
+
+@dataclass(frozen=True)
+class CheckpointConfig:
+    """Checkpoint/resume (ref: parameters.py:204-222)."""
+    checkpoint_dir: str = "./checkpoint/"
+    resume: Optional[str] = None
+    checkpoint_index: Optional[str] = None
+    save_all_models: bool = False
+    save_some_models: str = "1,29,59"
+    log_dir: str = "./logdir/"
+    track_model_aggregation: bool = False
+    check_model_at_sync: bool = False
+    debug: bool = False
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Device mesh layout — replaces the reference's process topology
+    (``FCGraph``, utils/topology.py:57-114) with a JAX mesh.
+
+    ``num_devices=None`` means "all visible devices". Clients are laid out
+    ``[num_devices, clients_per_device]``; the per-device axis is vmapped,
+    the device axis is sharded (SURVEY.md §7 phase 1 / hard part "100+
+    clients on a fixed mesh").
+    """
+    backend: Optional[str] = None  # None = default platform
+    num_devices: Optional[int] = None
+    axis_name: str = "clients"
+    # Multi-host (DCN) initialization; mirrors run_mpi.py's hostfile role.
+    coordinator_address: Optional[str] = None
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
+    compute_dtype: str = "float32"  # 'bfloat16' for MXU-friendly matmuls
+
+
+@dataclass(frozen=True)
+class ExperimentConfig:
+    data: DataConfig = field(default_factory=DataConfig)
+    federated: FederatedConfig = field(default_factory=FederatedConfig)
+    model: ModelConfig = field(default_factory=ModelConfig)
+    optim: OptimConfig = field(default_factory=OptimConfig)
+    lr_schedule: LRConfig = field(default_factory=LRConfig)
+    train: TrainConfig = field(default_factory=TrainConfig)
+    checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    experiment: Optional[str] = None
+
+    def finalize(self) -> "ExperimentConfig":
+        """Apply the reference's post-parse derivations & validations
+        (parameters.py:245-259)."""
+        data, fed, train, optim = self.data, self.federated, self.train, self.optim
+
+        if data.growing_batch_size and data.base_batch_size is None:
+            data = dataclasses.replace(data, base_batch_size=1)
+
+        if fed.federated:
+            if data.reshuffle_per_epoch:
+                raise ValueError(
+                    "Federated mode cannot reshuffle data across clients "
+                    "mid-training; set reshuffle_per_epoch=False "
+                    "(ref: parameters.py:246-247).")
+            # num_epochs = epochs/comm * comms * online rate (parameters.py:248)
+            train = dataclasses.replace(
+                train,
+                num_epochs=int(fed.num_epochs_per_comm * fed.num_comms
+                               * fed.online_client_rate))
+            if fed.algorithm == "afl":
+                # AFL runs exactly one local step per round (parameters.py:249-251).
+                fed = dataclasses.replace(fed, sync_type="local_step")
+                train = dataclasses.replace(train, local_step=1)
+            if fed.algorithm == "qsparse" and not fed.compressed:
+                # The reference *intends* this coercion (parameters.py:252
+                # has a bug: `args.compressed == True` comparison); we apply
+                # the intended semantics.
+                fed = dataclasses.replace(fed, compressed=True)
+            if fed.quantized and fed.compressed:
+                raise ValueError(
+                    "Quantization is mutually exclusive with compression "
+                    "(ref: parameters.py:254-255).")
+            if fed.algorithm in PERSONALIZED_ALGORITHMS and not fed.personal:
+                fed = dataclasses.replace(fed, personal=True)
+        else:
+            if train.num_epochs is None and train.num_iterations is None:
+                train = dataclasses.replace(train, num_epochs=10)
+
+        if optim.out_momentum and optim.out_momentum_factor is None:
+            # Default out-momentum 1 - 1/n (ref: components/optimizer.py:24-26).
+            n = max(fed.num_clients, 1)
+            optim = dataclasses.replace(optim, out_momentum_factor=1.0 - 1.0 / n)
+
+        if fed.algorithm not in FEDERATED_ALGORITHMS:
+            raise ValueError(f"Unknown federated algorithm {fed.algorithm!r}; "
+                             f"expected one of {FEDERATED_ALGORITHMS}")
+        if data.dataset not in DATASETS:
+            raise ValueError(f"Unknown dataset {data.dataset!r}")
+
+        return dataclasses.replace(
+            self, data=data, federated=fed, train=train, optim=optim)
+
+    # -- Derived quantities -------------------------------------------------
+    @property
+    def effective_algorithm(self) -> str:
+        """DRFA wraps an inner aggregation algorithm (parameters.py:90-93)."""
+        return "drfa" if self.federated.drfa else self.federated.algorithm
+
+    def batches_per_epoch(self, samples_per_client: int) -> int:
+        return max(samples_per_client // self.data.batch_size, 1)
+
+    def local_steps_per_round(self, samples_per_client: int) -> int:
+        """Fixed trace-time local-step count for one communication round.
+
+        The reference's `while not is_sync_fed` (federated/main.py:83-155)
+        has data-dependent bounds; on TPU the loop is a `lax.scan` with a
+        static length (SURVEY.md §7 'hard parts'). Epoch-sync mode converts
+        to steps exactly like the centered code (nodes_centered.py:47-50).
+        """
+        if self.federated.sync_type == "epoch":
+            return self.batches_per_epoch(samples_per_client) * \
+                self.federated.num_epochs_per_comm
+        return max(self.train.local_step, 1)

@@ -1,0 +1,1 @@
+from fedtorch_tpu.core import optim, schedule, sync  # noqa: F401

@@ -1,0 +1,161 @@
+"""Dual-mode functional optimizers.
+
+The reference's custom ``SGD`` (``/root/reference/fedtorch/components/
+optimizers/sgd.py:67-129``) has two entry modes sharing one state dict:
+
+* ``step(apply_lr=True)`` — a normal local step: weight decay, *in*-momentum
+  buffer, ``p -= lr * d``.
+* ``step(apply_lr=False, scale=s, apply_out_momentum=True)`` — the server
+  step used by every aggregation rule: no weight decay, *out*-momentum
+  buffer, ``p -= s * d`` (``sgd.py:125-128``).
+
+Here both modes are pure functions over parameter/optimizer pytrees, so the
+same code runs under ``vmap`` (a batch of per-client optimizers — the
+centered mode of the reference) and under ``jit``/``shard_map`` on a mesh.
+``AdamW`` mirrors ``optimizers/adam.py:48-104`` including its
+``correct_wd`` decoupled-decay switch and the same ``apply_lr=False``
+server-step escape hatch (``adam.py:69-70``).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from fedtorch_tpu.config import OptimConfig
+
+
+class SGDState(NamedTuple):
+    """Dual momentum buffers, same pytree structure as the params."""
+    in_buf: any
+    out_buf: any
+
+
+class AdamState(NamedTuple):
+    exp_avg: any
+    exp_avg_sq: any
+    step: jnp.ndarray  # scalar int32
+    out_buf: any       # server-step out-momentum buffer
+
+
+def init_sgd(params) -> SGDState:
+    zeros = jax.tree.map(jnp.zeros_like, params)
+    return SGDState(in_buf=zeros, out_buf=jax.tree.map(jnp.zeros_like, params))
+
+
+def init_adam(params) -> AdamState:
+    z = lambda: jax.tree.map(jnp.zeros_like, params)
+    return AdamState(exp_avg=z(), exp_avg_sq=z(),
+                     step=jnp.zeros((), jnp.int32), out_buf=z())
+
+
+def _momentum_update(buf, d, factor, dampening, nesterov):
+    """buf <- factor*buf + (1-dampening)*d ; returns (direction, new_buf).
+
+    With a zero-initialized buffer this matches the reference's first-step
+    special case (sgd.py:103-106) exactly, since mul_(m).add_(d) on zeros
+    equals d.
+    """
+    new_buf = jax.tree.map(
+        lambda b, g: factor * b + (1.0 - dampening) * g, buf, d)
+    if nesterov:
+        direction = jax.tree.map(lambda g, b: g + factor * b, d, new_buf)
+    else:
+        direction = new_buf
+    return direction, new_buf
+
+
+def sgd_local_step(params, grads, state: SGDState, lr, cfg: OptimConfig):
+    """Local (client) step: mirrors sgd.py step(apply_lr=True).
+
+    `lr` may be a traced scalar (per-step scheduled LR).
+    """
+    if cfg.weight_decay:
+        grads = jax.tree.map(lambda g, p: g + cfg.weight_decay * p,
+                             grads, params)
+    in_buf = state.in_buf
+    if cfg.in_momentum and cfg.in_momentum_factor:
+        grads, in_buf = _momentum_update(
+            in_buf, grads, cfg.in_momentum_factor, cfg.dampening,
+            cfg.use_nesterov)
+    new_params = jax.tree.map(lambda p, d: p - lr * d, params, grads)
+    return new_params, SGDState(in_buf=in_buf, out_buf=state.out_buf)
+
+
+def sgd_server_step(params, direction, state: SGDState, scale,
+                    cfg: OptimConfig):
+    """Server step: mirrors sgd.py step(apply_lr=False, scale=s,
+    apply_out_momentum=True). No weight decay, no LR; out-momentum buffer.
+
+    ``direction`` is the aggregated model delta ("delta-as-grad" trick,
+    algorithms/distributed.py:120-126 / fedavg.py:30-34)."""
+    out_buf = state.out_buf
+    if cfg.out_momentum and cfg.out_momentum_factor:
+        direction, out_buf = _momentum_update(
+            out_buf, direction, cfg.out_momentum_factor, cfg.dampening,
+            cfg.use_nesterov)
+    new_params = jax.tree.map(lambda p, d: p - scale * d, params, direction)
+    return new_params, SGDState(in_buf=state.in_buf, out_buf=out_buf)
+
+
+def adam_local_step(params, grads, state: AdamState, lr, cfg: OptimConfig):
+    """AdamW local step, mirroring adam.py:71-104 (correct_wd switch)."""
+    step = state.step + 1
+    b1, b2 = cfg.adam_beta1, cfg.adam_beta2
+    if cfg.weight_decay and not cfg.correct_wd:
+        # Classic L2-into-gradient (adam.py:77-78 when not correct_wd).
+        grads = jax.tree.map(lambda g, p: g + cfg.weight_decay * p,
+                             grads, params)
+    exp_avg = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g,
+                           state.exp_avg, grads)
+    exp_avg_sq = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * g * g,
+                              state.exp_avg_sq, grads)
+    bc1 = 1 - b1 ** step.astype(jnp.float32)
+    bc2 = 1 - b2 ** step.astype(jnp.float32)
+    step_size = lr * jnp.sqrt(bc2) / bc1
+
+    def upd(p, m, v):
+        new_p = p - step_size * m / (jnp.sqrt(v) + cfg.adam_eps)
+        if cfg.weight_decay and cfg.correct_wd:
+            # Decoupled weight decay (adam.py:96-97).
+            new_p = new_p - lr * cfg.weight_decay * p
+        return new_p
+
+    new_params = jax.tree.map(upd, params, exp_avg, exp_avg_sq)
+    return new_params, AdamState(exp_avg=exp_avg, exp_avg_sq=exp_avg_sq,
+                                 step=step, out_buf=state.out_buf)
+
+
+def adam_server_step(params, direction, state: AdamState, scale,
+                     cfg: OptimConfig):
+    """Server-step escape hatch (adam.py:69-70): plain p -= scale*d."""
+    out_buf = state.out_buf
+    if cfg.out_momentum and cfg.out_momentum_factor:
+        direction, out_buf = _momentum_update(
+            out_buf, direction, cfg.out_momentum_factor, cfg.dampening,
+            cfg.use_nesterov)
+    new_params = jax.tree.map(lambda p, d: p - scale * d, params, direction)
+    return new_params, state._replace(out_buf=out_buf)
+
+
+# -- Dispatch ---------------------------------------------------------------
+
+def init_opt_state(params, cfg: OptimConfig):
+    if cfg.optimizer == "sgd":
+        return init_sgd(params)
+    if cfg.optimizer in ("adam", "adamw"):
+        return init_adam(params)
+    raise ValueError(f"Unknown optimizer {cfg.optimizer!r}")
+
+
+def local_step(params, grads, state, lr, cfg: OptimConfig):
+    if isinstance(state, SGDState):
+        return sgd_local_step(params, grads, state, lr, cfg)
+    return adam_local_step(params, grads, state, lr, cfg)
+
+
+def server_step(params, direction, state, scale, cfg: OptimConfig):
+    if isinstance(state, SGDState):
+        return sgd_server_step(params, direction, state, scale, cfg)
+    return adam_server_step(params, direction, state, scale, cfg)

@@ -1,0 +1,10 @@
+from fedtorch_tpu.ops.quantize import (  # noqa: F401
+    QuantInfo, dequantize, dequantize_pytree, quantize, quantize_dequantize,
+    quantize_pytree,
+)
+from fedtorch_tpu.ops.simplex import (  # noqa: F401
+    project_simplex, project_simplex_floor,
+)
+from fedtorch_tpu.ops.topk import (  # noqa: F401
+    Sparse, compress, compress_pytree, decompress, topk_roundtrip,
+)

@@ -1,0 +1,101 @@
+"""Adaptive affine quantization, in-graph.
+
+Rebuild of ``/root/reference/fedtorch/comms/utils/flow_utils.py:169-212``
+(``quantize_tensor`` / ``dequantize_tensor``) as jittable functions: on TPU
+the quantized payload is not a wire format but an in-graph transform applied
+to model deltas before the aggregation collective (SURVEY.md §2.10), which
+shrinks the ICI/DCN all-gather payload 4x (int8) while keeping shapes
+static.
+
+Semantics preserved from the reference:
+* symmetric integer range ``[-2^(b-1), 2^(b-1)-1]``;
+* adaptive mode computes ``scale=(max-min)/(qmax-qmin)`` with a 0.001
+  floor when the tensor is constant, a zero point clipped into the integer
+  range and truncated toward zero (``int(...)``), and centers on the mean;
+* dequantize: ``scale*(q - zero_point) + mean``.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# Non-adaptive defaults (flow_utils.py:8).
+SCALE_QUANTIZE = 0.001
+ZERO_POINT_QUANTIZE = 0.0
+
+
+class QuantInfo(NamedTuple):
+    """The [scale, zero_point, mean] triple the reference sends alongside
+    the payload (flow_utils.py:205)."""
+    scale: jnp.ndarray
+    zero_point: jnp.ndarray
+    mean: jnp.ndarray
+
+
+def _int_dtype(num_bits: int):
+    if num_bits == 8:
+        return jnp.int8
+    if num_bits == 16:
+        return jnp.int16
+    raise ValueError(f"Unsupported quantization bits: {num_bits}")
+
+
+def quantize(x: jnp.ndarray, num_bits: int = 8, adaptive: bool = True,
+             info: QuantInfo | None = None) -> Tuple[jnp.ndarray, QuantInfo]:
+    """Affine-quantize ``x``; returns (int payload, QuantInfo)."""
+    qmin = -(2.0 ** (num_bits - 1))
+    qmax = 2.0 ** (num_bits - 1) - 1.0
+    x = jnp.asarray(x)
+    if adaptive:
+        min_val, max_val, mean_val = x.min(), x.max(), x.mean()
+        scale = (max_val - min_val) / (qmax - qmin)
+        scale = jnp.where(scale == 0.0, 0.001, scale)
+        init_zp = qmin - (min_val - mean_val) / scale
+        # int() in the reference truncates toward zero after clipping.
+        zero_point = jnp.trunc(jnp.clip(init_zp, qmin, qmax))
+    elif info is not None:
+        scale, zero_point, mean_val = info.scale, info.zero_point, info.mean
+    else:
+        scale = jnp.asarray(SCALE_QUANTIZE, x.dtype)
+        zero_point = jnp.asarray(ZERO_POINT_QUANTIZE, x.dtype)
+        mean_val = jnp.asarray(0.0, x.dtype)
+
+    q = zero_point + (x - mean_val) / scale
+    q = jnp.clip(jnp.round(q), qmin, qmax).astype(_int_dtype(num_bits))
+    return q, QuantInfo(scale=scale.astype(jnp.float32),
+                        zero_point=zero_point.astype(jnp.float32),
+                        mean=mean_val.astype(jnp.float32))
+
+
+def dequantize(q: jnp.ndarray, info: QuantInfo | None = None) -> jnp.ndarray:
+    """Inverse transform (flow_utils.py:208-212)."""
+    qf = q.astype(jnp.float32)
+    if info is None:
+        return SCALE_QUANTIZE * (qf - ZERO_POINT_QUANTIZE)
+    return info.scale * (qf - info.zero_point) + info.mean
+
+
+def quantize_pytree(tree, num_bits: int = 8):
+    """Quantize every leaf of a pytree; returns (payload tree, info tree)."""
+    leaves, treedef = jax.tree.flatten(tree)
+    qs, infos = [], []
+    for leaf in leaves:
+        q, info = quantize(leaf, num_bits=num_bits, adaptive=True)
+        qs.append(q)
+        infos.append(info)
+    return jax.tree.unflatten(treedef, qs), jax.tree.unflatten(treedef, infos)
+
+
+def dequantize_pytree(payload, infos):
+    leaves_q, treedef = jax.tree.flatten(payload)
+    leaves_i = treedef.flatten_up_to(infos)
+    return jax.tree.unflatten(
+        treedef, [dequantize(q, i) for q, i in zip(leaves_q, leaves_i)])
+
+
+def quantize_dequantize(x: jnp.ndarray, num_bits: int = 8) -> jnp.ndarray:
+    """Round-trip, i.e. the value the receiver reconstructs."""
+    q, info = quantize(x, num_bits=num_bits, adaptive=True)
+    return dequantize(q, info)

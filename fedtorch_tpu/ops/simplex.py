@@ -1,0 +1,39 @@
+"""Euclidean projection onto the probability simplex, jittable.
+
+Rebuild of ``euclidean_proj_simplex`` / ``projection_simplex_sort``
+(``/root/reference/fedtorch/comms/utils/flow_utils.py:52-157``), used by
+the AFL and DRFA dual-variable updates. The reference runs these on CPU
+between rounds; here the projection is an O(n log n) sort expressed in
+``jnp`` so the whole dual update stays inside the jitted round program.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def project_simplex(v: jnp.ndarray, s: float = 1.0) -> jnp.ndarray:
+    """min_w ||w - v||^2 s.t. sum(w) = s, w >= 0 (Duchi et al., ICML'08).
+
+    Matches flow_utils.py:52-97 including the degenerate rho=0 fallback
+    when no component satisfies the support condition."""
+    v = jnp.asarray(v, jnp.float32)
+    n = v.shape[0]
+    u = jnp.sort(v)[::-1]                       # decreasing
+    cssv = jnp.cumsum(u)
+    ind = jnp.arange(1, n + 1, dtype=v.dtype)
+    cond = u * ind > (cssv - s)
+    # rho = last index satisfying cond; 0 if none (reference :88-91).
+    rho = jnp.max(jnp.where(cond, jnp.arange(n), 0))
+    theta = (cssv[rho] - s) / (rho + 1.0)
+    return jnp.clip(v - theta, 0.0, None)
+
+
+def project_simplex_floor(v: jnp.ndarray, s: float = 1.0,
+                          floor: float = 1e-3) -> jnp.ndarray:
+    """Projection followed by the DRFA lambda floor
+    (federated/drfa.py:246-250): entries <= floor are raised to the floor so
+    every client keeps nonzero sampling probability, then the vector is
+    renormalized once (the reference does not re-floor after normalizing)."""
+    w = project_simplex(v, s)
+    w = jnp.where(w <= floor, floor, w)
+    return w / jnp.sum(w) * s

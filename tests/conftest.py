@@ -1,0 +1,17 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+This is the JAX-native analog of the reference's 'centered mode' fake
+backend (SURVEY.md §4): all collective code paths execute in CI without a
+TPU by forcing the host platform to expose 8 devices.
+
+Must run before jax is imported anywhere.
+"""
+import os
+
+# Force CPU even when the ambient environment selects a TPU platform
+# (e.g. JAX_PLATFORMS=axon): the test mesh is always the virtual host mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()

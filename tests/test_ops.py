@@ -1,0 +1,139 @@
+"""Unit tests for in-graph ops: quantize, top-k, simplex projection.
+
+Where a torch reference implementation exists in /root/reference
+(flow_utils.py), we cross-check numerics against it directly (torch-cpu is
+available in the test image) — this validates semantic parity without
+copying code.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from fedtorch_tpu.ops import (
+    compress, decompress, dequantize, project_simplex, project_simplex_floor,
+    quantize, quantize_dequantize, topk_roundtrip,
+)
+
+
+class TestQuantize:
+    def test_roundtrip_error_bounded(self):
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(1000).astype(np.float32))
+        for bits in (8, 16):
+            q, info = quantize(x, num_bits=bits, adaptive=True)
+            xr = dequantize(q, info)
+            # rounding gives scale/2; zero-point truncation can push edge
+            # values past the clip range for up to one extra scale unit
+            assert float(jnp.max(jnp.abs(xr - x))) <= float(info.scale) * 1.51 + 1e-6
+
+    def test_dtypes(self):
+        x = jnp.linspace(-1, 1, 64)
+        q8, _ = quantize(x, num_bits=8)
+        q16, _ = quantize(x, num_bits=16)
+        assert q8.dtype == jnp.int8 and q16.dtype == jnp.int16
+
+    def test_constant_tensor_scale_floor(self):
+        x = jnp.full((32,), 3.14)
+        q, info = quantize(x, num_bits=8)
+        assert float(info.scale) == pytest.approx(0.001)
+        xr = dequantize(q, info)
+        np.testing.assert_allclose(np.asarray(xr), np.asarray(x), atol=1e-3)
+
+    def test_matches_torch_reference(self):
+        torch = pytest.importorskip("torch")
+        import sys
+        sys.path.insert(0, "/root/reference")
+        from fedtorch.comms.utils.flow_utils import (
+            quantize_tensor, dequantize_tensor)
+        rng = np.random.RandomState(42)
+        x_np = rng.randn(257).astype(np.float32)
+        q_t, info_t = quantize_tensor(torch.tensor(x_np), num_bits=8,
+                                      adaptive=True)
+        x_t = dequantize_tensor(q_t, info_t).numpy()
+        x_j = np.asarray(quantize_dequantize(jnp.asarray(x_np), num_bits=8))
+        np.testing.assert_allclose(x_j, x_t, atol=2e-2, rtol=0)
+        # bulk agreement: identical reconstruction for almost all elements
+        # (round-half ties may differ at fp boundaries)
+        frac_equal = np.mean(np.abs(x_j - x_t) < 1e-6)
+        assert frac_equal > 0.98
+
+    def test_jittable(self):
+        f = jax.jit(lambda x: quantize_dequantize(x, 8))
+        x = jnp.linspace(-2, 2, 128)
+        # jit fusion may flip round-half ties at bin boundaries; agree to
+        # within one quantization bin
+        np.testing.assert_allclose(np.asarray(f(x)),
+                                   np.asarray(quantize_dequantize(x, 8)),
+                                   atol=4.0 / 255 + 1e-6)
+
+
+class TestTopK:
+    def test_keeps_largest(self):
+        x = jnp.asarray([0.1, -5.0, 0.2, 3.0, -0.05, 0.0, 1.0, -2.0])
+        sp = compress(x, ratio=1.0)  # k = 8*1/2 = 4
+        assert sp.values.shape == (4,)
+        dense = decompress(sp)
+        np.testing.assert_allclose(
+            np.asarray(dense),
+            np.asarray([0.0, -5.0, 0.0, 3.0, 0.0, 0.0, 1.0, -2.0]))
+
+    def test_k_rule_matches_reference(self):
+        # k = int(n*r/2), flow_utils.py:221
+        x = jnp.arange(100, dtype=jnp.float32)
+        sp = compress(x, ratio=0.5)
+        assert sp.values.shape == (25,)
+
+    def test_ratio_too_low_raises(self):
+        with pytest.raises(ValueError):
+            compress(jnp.arange(3, dtype=jnp.float32), ratio=0.1)
+
+    def test_roundtrip_preserves_shape(self):
+        x = jnp.ones((4, 8))
+        y = topk_roundtrip(x, ratio=0.5)
+        assert y.shape == x.shape
+
+    def test_jit_static_k(self):
+        f = jax.jit(lambda x: topk_roundtrip(x, 0.5))
+        x = jnp.asarray(np.random.RandomState(1).randn(64).astype(np.float32))
+        y = f(x)
+        assert int(jnp.sum(y != 0)) == 16
+
+
+class TestSimplex:
+    def test_already_on_simplex(self):
+        v = jnp.asarray([0.2, 0.3, 0.5])
+        np.testing.assert_allclose(np.asarray(project_simplex(v)),
+                                   np.asarray(v), atol=1e-6)
+
+    def test_sums_to_one_nonneg(self):
+        rng = np.random.RandomState(3)
+        for _ in range(5):
+            v = jnp.asarray(rng.randn(50).astype(np.float32) * 3)
+            w = project_simplex(v)
+            assert float(jnp.sum(w)) == pytest.approx(1.0, abs=1e-5)
+            assert float(jnp.min(w)) >= 0.0
+
+    def test_matches_reference_numpy_sort(self):
+        import sys
+        sys.path.insert(0, "/root/reference")
+        from fedtorch.comms.utils.flow_utils import projection_simplex_sort
+        rng = np.random.RandomState(7)
+        v = rng.randn(30).astype(np.float64)
+        w_ref = projection_simplex_sort(v.copy())
+        w = np.asarray(project_simplex(jnp.asarray(v, jnp.float32)))
+        np.testing.assert_allclose(w, w_ref, atol=1e-5)
+
+    def test_floor(self):
+        v = jnp.asarray([10.0, -10.0, -10.0, -10.0])
+        w = project_simplex_floor(v, floor=1e-3)
+        # after the single renormalization the floor holds up to the
+        # normalizer (reference drfa.py:246-250 semantics)
+        assert float(jnp.min(w)) >= 1e-3 / (1.0 + 4 * 1e-3) - 1e-9
+        assert float(jnp.sum(w)) == pytest.approx(1.0, abs=1e-5)
+
+    def test_jittable(self):
+        f = jax.jit(project_simplex)
+        v = jnp.asarray([3.0, 1.0, -2.0])
+        w = f(v)
+        assert float(jnp.sum(w)) == pytest.approx(1.0, abs=1e-6)

@@ -1,0 +1,162 @@
+"""LR schedule compiler tests, cross-checked against the reference's
+closure-based scheduler (optimizers/learning.py) run directly."""
+import sys
+import types
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from fedtorch_tpu.config import LRConfig, OptimConfig, TrainConfig
+from fedtorch_tpu.core.schedule import compile_schedule, lr_at
+from fedtorch_tpu.core.sync import define_sync_freq
+
+sys.path.insert(0, "/root/reference")
+
+
+def _ref_scheduler(**kw):
+    """Build the reference scheduler from a minimal args namespace."""
+    from fedtorch.components.optimizers.learning import get_lr_scheduler
+    args = types.SimpleNamespace(
+        lr_schedule_scheme=None, lr_change_epochs=None, lr_fields=None,
+        lr_scale_indicators=None, lr_warmup=False, lr_warmup_epochs=5,
+        lr_decay=10.0, learning_rate=0.1, init_warmup_lr=0.1,
+        num_epochs=30, lr_gamma=None, lr_mu=None, lr_alpha=None,
+        lr_onecycle_low=0.15, lr_onecycle_high=3.0,
+        lr_onecycle_extra_low=0.0015, lr_onecycle_num_epoch=46)
+    for k, v in kw.items():
+        setattr(args, k, v)
+    return get_lr_scheduler(args), args
+
+
+def test_strict_matches_reference():
+    ref, args = _ref_scheduler(
+        lr_schedule_scheme="strict", lr_change_epochs="10,20",
+        lr_fields="0.1,0.1/0.01,0.01/0.001,0.001",
+        lr_scale_indicators="0,0,0", num_epochs=30)
+    sched = compile_schedule(
+        LRConfig(schedule_scheme="strict", lr_change_epochs="10,20",
+                 lr_fields="0.1,0.1/0.01,0.01/0.001,0.001",
+                 lr_scale_indicators="0,0,0"),
+        OptimConfig(lr=0.1), num_epochs=30)
+    for e in [0.0, 0.5, 9.99, 10.0, 15.7, 20.0, 29.9]:
+        assert float(lr_at(sched, e)) == pytest.approx(ref(e), rel=1e-6), e
+
+
+def test_multistep_matches_reference():
+    ref, args = _ref_scheduler(
+        lr_schedule_scheme="custom_multistep", lr_change_epochs="15,25",
+        num_epochs=40)
+    sched = compile_schedule(
+        LRConfig(schedule_scheme="custom_multistep", lr_change_epochs="15,25",
+                 decay=10.0),
+        OptimConfig(lr=0.1), num_epochs=40)
+    for e in [0.0, 7.3, 14.99, 15.0, 20.0, 25.0, 39.5]:
+        assert float(lr_at(sched, e)) == pytest.approx(ref(e), rel=1e-6), e
+
+
+def test_onecycle_matches_reference():
+    ref, args = _ref_scheduler(lr_schedule_scheme="custom_one_cycle",
+                               num_epochs=60)
+    sched = compile_schedule(
+        LRConfig(schedule_scheme="custom_one_cycle"),
+        OptimConfig(lr=0.1), num_epochs=60)
+    for e in [0.0, 11.5, 23.0, 34.5, 46.0, 59.0]:
+        assert float(lr_at(sched, e)) == pytest.approx(ref(e), rel=1e-5), e
+
+
+def test_convex_decay_matches_reference():
+    ref, args = _ref_scheduler(
+        lr_schedule_scheme="custom_convex_decay", lr_gamma=1.0, lr_mu=0.5,
+        lr_alpha=1.0, num_epochs=20)
+    sched = compile_schedule(
+        LRConfig(schedule_scheme="custom_convex_decay", gamma=1.0, mu=0.5,
+                 alpha=1.0),
+        OptimConfig(lr=0.1), num_epochs=20)
+    for e in [0.0, 1.0, 5.5, 19.9]:
+        assert float(lr_at(sched, e)) == pytest.approx(ref(e), rel=1e-5), e
+
+
+def test_constant_default():
+    sched = compile_schedule(LRConfig(), OptimConfig(lr=0.03), num_epochs=10)
+    assert float(lr_at(sched, 0.0)) == pytest.approx(0.03)
+    assert float(lr_at(sched, 9.99)) == pytest.approx(0.03)
+    # saturates past the end rather than returning 0/None
+    assert float(lr_at(sched, 10.5)) == pytest.approx(0.03)
+
+
+def test_jit_and_scan_evaluable():
+    sched = compile_schedule(
+        LRConfig(schedule_scheme="custom_multistep", lr_change_epochs="5",
+                 decay=10.0),
+        OptimConfig(lr=0.1), num_epochs=10)
+
+    def body(carry, e):
+        return carry, lr_at(sched, e)
+
+    _, lrs = jax.lax.scan(body, 0, jnp.asarray([0.0, 4.9, 5.0, 9.9]))
+    np.testing.assert_allclose(np.asarray(lrs), [0.1, 0.1, 0.01, 0.01],
+                               rtol=1e-5)
+
+
+class TestSyncScheme:
+    def _ref(self, **kw):
+        from fedtorch.comms.algorithms.distributed import define_sync_freq \
+            as ref_fn
+        defaults = dict(num_epochs=10, local_step=4,
+                        local_step_warmup_type=None,
+                        local_step_warmup_period=None,
+                        turn_on_local_step_from=None,
+                        turn_off_local_step_from=None,
+                        warmup_per_intervals=False, lr_change_epochs=None)
+        defaults.update(kw)
+        return ref_fn(**defaults), define_sync_freq(**defaults)
+
+    def test_plain(self):
+        ref, ours = self._ref()
+        assert ref == ours
+
+    @pytest.mark.parametrize("warmup", ["exp", "linear", "constant"])
+    def test_warmup_types(self, warmup):
+        ref, ours = self._ref(local_step_warmup_type=warmup,
+                              local_step_warmup_period=6)
+        assert ref == ours
+
+    def test_turn_off(self):
+        ref, ours = self._ref(lr_change_epochs="5",
+                              turn_off_local_step_from=5)
+        assert ref == ours
+
+    def test_turn_on(self):
+        ref, ours = self._ref(lr_change_epochs="5",
+                              turn_on_local_step_from=5)
+        assert ref == ours
+
+    def test_warmup_per_interval(self):
+        ref, ours = self._ref(lr_change_epochs="6", warmup_per_intervals=True,
+                              local_step_warmup_type="linear",
+                              local_step_warmup_period=3)
+        assert ref == ours
+
+
+def test_config_finalize_derivations():
+    from fedtorch_tpu.config import ExperimentConfig, FederatedConfig
+    cfg = ExperimentConfig(
+        federated=FederatedConfig(federated=True, num_comms=20,
+                                  num_epochs_per_comm=2,
+                                  online_client_rate=0.5,
+                                  algorithm="afl")).finalize()
+    # num_epochs = 2*20*0.5 (parameters.py:248)
+    assert cfg.train.num_epochs == 20
+    # afl coercions (parameters.py:249-251)
+    assert cfg.federated.sync_type == "local_step"
+    assert cfg.train.local_step == 1
+
+    cfg2 = ExperimentConfig(
+        federated=FederatedConfig(federated=True, algorithm="apfl")).finalize()
+    assert cfg2.federated.personal  # parameters.py:257-259
+
+    with pytest.raises(ValueError):
+        ExperimentConfig(federated=FederatedConfig(
+            federated=True, quantized=True, compressed=True)).finalize()
