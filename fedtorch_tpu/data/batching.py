@@ -1,0 +1,148 @@
+"""Device-side federated data layout.
+
+The reference feeds each client from its own ``DataLoader``
+(components/dataset.py:83-231); on TPU the whole federated dataset lives
+on-device as ``[clients, N_max, ...]`` arrays padded per client with an
+explicit size vector (SURVEY.md §7 'per-client heterogeneous dataset
+sizes'), so batch selection happens *inside* the jitted round program —
+no per-batch host->device copies (the reference pays an H2D copy per batch,
+dataset.py:12-36).
+
+Batch selection reproduces epoch semantics (each sample visited once per
+epoch) via an in-graph random permutation per (client, epoch): uniform
+keys with +inf on the padding tail, argsort, then wraparound indexing by
+the local step counter.
+"""
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class ClientData(NamedTuple):
+    """Per-client padded arrays. ``x: [C, N_max, ...]``, ``y: [C, N_max]``,
+    ``sizes: [C]`` true sample counts."""
+    x: jnp.ndarray
+    y: jnp.ndarray
+    sizes: jnp.ndarray
+
+    @property
+    def num_clients(self) -> int:
+        return self.x.shape[0]
+
+    @property
+    def n_max(self) -> int:
+        return self.x.shape[1]
+
+
+def stack_partitions(features: np.ndarray, labels: np.ndarray,
+                     partitions: Sequence[np.ndarray],
+                     n_max: Optional[int] = None) -> ClientData:
+    """Stack per-client index lists into padded device arrays.
+
+    Padding repeats each client's own samples cyclically, so a padded row
+    is always a *valid* sample of that client (masking is still applied
+    for weighting, but a stray padded draw never injects another client's
+    data)."""
+    sizes = np.asarray([len(p) for p in partitions])
+    if np.any(sizes == 0):
+        raise ValueError("Every client needs at least one sample; got a "
+                         f"zero-sized partition (sizes={sizes.tolist()})")
+    if n_max is None:
+        n_max = int(sizes.max())
+    xs, ys = [], []
+    for p in partitions:
+        idx = np.asarray(p)
+        reps = int(np.ceil(n_max / len(idx)))
+        idx_padded = np.tile(idx, reps)[:n_max]
+        xs.append(features[idx_padded])
+        ys.append(labels[idx_padded])
+    return ClientData(x=jnp.asarray(np.stack(xs)),
+                      y=jnp.asarray(np.stack(ys)),
+                      sizes=jnp.asarray(sizes, jnp.int32))
+
+
+def epoch_permutation(rng: jax.Array, size: jnp.ndarray,
+                      n_max: int) -> jnp.ndarray:
+    """A random permutation of [0, size) padded (cyclically) to n_max.
+
+    Uniform sort keys with +inf past ``size`` put all real samples first
+    in random order; indexing past ``size`` wraps around."""
+    keys = jax.random.uniform(rng, (n_max,))
+    keys = jnp.where(jnp.arange(n_max) < size, keys, jnp.inf)
+    return jnp.argsort(keys)
+
+
+def take_batch(data_x: jnp.ndarray, data_y: jnp.ndarray,
+               perm: jnp.ndarray, size: jnp.ndarray,
+               step_in_epoch: jnp.ndarray, batch_size: int):
+    """Gather batch ``step_in_epoch`` from one client's permuted epoch.
+
+    Index arithmetic wraps modulo the true client size, so short clients
+    cycle within the epoch (the reference instead drops size-1 remainder
+    batches, federated/main.py:104-106 — masking handles weighting here)."""
+    offsets = step_in_epoch * batch_size + jnp.arange(batch_size)
+    idx = perm[offsets % jnp.maximum(size, 1)]
+    return data_x[idx], data_y[idx]
+
+
+def sample_batch(rng: jax.Array, data_x: jnp.ndarray, data_y: jnp.ndarray,
+                 size: jnp.ndarray, batch_size: int):
+    """Uniform-with-replacement batch draw (used where the reference
+    samples a single random batch, e.g. DRFA's loss phase)."""
+    idx = jax.random.randint(rng, (batch_size,), 0,
+                             jnp.maximum(size, 1))
+    return data_x[idx], data_y[idx]
+
+
+def train_val_split(partitions: Sequence[np.ndarray], val_fraction: float,
+                    seed: int = 0):
+    """Per-client train/val random split for personalization
+    (components/dataset.py:168-211 random_split equivalent)."""
+    rng = np.random.RandomState(seed)
+    train_parts, val_parts = [], []
+    for p in partitions:
+        p = np.asarray(p)
+        perm = rng.permutation(len(p))
+        n_val = max(int(len(p) * val_fraction), 1) if len(p) > 1 else 0
+        val_parts.append(p[perm[:n_val]])
+        train_parts.append(p[perm[n_val:]])
+    return train_parts, val_parts
+
+
+def growing_batch_schedule(base_batch_size: int = 2,
+                           max_batch_size: int = 0,
+                           num_samples_per_epoch: int = 0,
+                           num_epochs: Optional[int] = None,
+                           num_iterations: Optional[int] = None,
+                           rho: float = 1.01) -> List[int]:
+    """Growing-minibatch schedule: the per-step batch sizes.
+
+    Reference semantics (GrowingMinibatchSampler, components/
+    dataset.py:276-317): ``batch_size[i] = int(base*rho^i) + 1`` with the
+    iteration count derived from num_epochs (or vice versa) via the
+    geometric-sum formula; sizes above ``max_batch_size`` are replaced by
+    repeated max-size batches covering the same sample budget."""
+    if num_epochs is None:
+        if num_iterations is None:
+            raise ValueError(
+                "One of num_epochs or num_iterations must be provided.")
+    else:
+        num_iterations = int(
+            np.log(num_samples_per_epoch * num_epochs * (rho - 1)
+                   / base_batch_size + 1) / np.log(rho)) + 1
+    batch_sizes = [int(base_batch_size * rho ** i) + 1
+                   for i in range(num_iterations)]
+    if max_batch_size:
+        b = np.asarray(batch_sizes)
+        over = np.flatnonzero(b > max_batch_size)
+        if len(over) >= 1:
+            overflow = int(np.sum(b[over]))
+            batch_sizes = batch_sizes[:over[0]] \
+                + [max_batch_size] * (overflow // max_batch_size)
+            if overflow // max_batch_size:
+                batch_sizes += [overflow % max_batch_size]
+    return batch_sizes

@@ -1,0 +1,96 @@
+"""Model registry and factory.
+
+``define_model`` mirrors the reference dispatch (components/model.py:7-23):
+prefix matching for resnet/wideresnet/densenet arch strings, exact names
+otherwise. Cross-rank init consistency (model.py:33-43 zeroes non-rank-0
+params and all-reduces) is unnecessary here: a single shared PRNG key
+initializes params once; replication is handled by sharding.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from fedtorch_tpu.config import ExperimentConfig
+from fedtorch_tpu.models.cnn import CNN
+from fedtorch_tpu.models.common import (
+    CONVEX_DIMS, REGRESSION_DIMS, ModelDef, flat_input_size, image_shape,
+    num_classes_of,
+)
+from fedtorch_tpu.models.densenet import DenseNet, build_densenet
+from fedtorch_tpu.models.linear import LeastSquare, LinearMAFL, \
+    LogisticRegression
+from fedtorch_tpu.models.mlp import MLP
+from fedtorch_tpu.models.resnet import ResNetCifar, ResNetImageNet, \
+    build_resnet
+from fedtorch_tpu.models.rnn import CharGRU
+from fedtorch_tpu.models.wideresnet import WideResNet, build_wideresnet
+
+MODEL_NAMES = (
+    "logistic_regression", "robust_logistic_regression", "least_square",
+    "robust_least_square", "mlp", "robust_mlp", "cnn", "rnn",
+    # prefix families:
+    "resnet*", "wideresnet*", "densenet*",
+)
+
+
+def _sample_flat(dataset: str, batch: int = 2):
+    return jnp.zeros((batch, flat_input_size(dataset)), jnp.float32)
+
+
+def _sample_image(dataset: str, batch: int = 2):
+    return jnp.zeros((batch,) + image_shape(dataset), jnp.float32)
+
+
+def define_model(cfg: ExperimentConfig, batch_size: int = 2) -> ModelDef:
+    """Build a :class:`ModelDef` from config (ref dispatch model.py:7-23)."""
+    arch = cfg.model.arch
+    dataset = cfg.data.dataset
+    m = cfg.model
+
+    if arch.startswith("wideresnet"):
+        module = build_wideresnet(arch, dataset, m.wideresnet_widen_factor,
+                                  m.drop_rate, m.norm)
+        return ModelDef(arch, module, _sample_image(dataset, batch_size))
+    if arch.startswith("resnet"):
+        module = build_resnet(arch, dataset, m.norm)
+        return ModelDef(arch, module, _sample_image(dataset, batch_size))
+    if arch.startswith("densenet"):
+        module = build_densenet(arch, dataset, m.densenet_growth_rate,
+                                m.densenet_bc_mode, m.densenet_compression,
+                                m.drop_rate, m.norm)
+        return ModelDef(arch, module, _sample_image(dataset, batch_size))
+    if arch == "logistic_regression":
+        return ModelDef(arch, LogisticRegression(dataset=dataset),
+                        _sample_flat(dataset, batch_size))
+    if arch == "robust_logistic_regression":
+        return ModelDef(arch, LogisticRegression(dataset=dataset, robust=True),
+                        _sample_flat(dataset, batch_size),
+                        has_noise_param=True)
+    if arch == "least_square":
+        return ModelDef(arch, LeastSquare(dataset=dataset),
+                        jnp.zeros((batch_size, REGRESSION_DIMS[dataset])),
+                        is_regression=True)
+    if arch == "robust_least_square":
+        return ModelDef(arch, LeastSquare(dataset=dataset, robust=True),
+                        jnp.zeros((batch_size, REGRESSION_DIMS[dataset])),
+                        is_regression=True, has_noise_param=True)
+    if arch == "mlp":
+        module = MLP(dataset=dataset, num_layers=m.mlp_num_layers,
+                     hidden_size=m.mlp_hidden_size, drop_rate=m.drop_rate,
+                     norm=m.norm)
+        return ModelDef(arch, module, _sample_flat(dataset, batch_size))
+    if arch == "robust_mlp":
+        module = MLP(dataset=dataset, num_layers=m.mlp_num_layers,
+                     hidden_size=m.mlp_hidden_size, drop_rate=m.drop_rate,
+                     norm=m.norm, robust=True)
+        return ModelDef(arch, module, _sample_flat(dataset, batch_size),
+                        has_noise_param=True)
+    if arch == "cnn":
+        return ModelDef(arch, CNN(dataset=dataset),
+                        _sample_image(dataset, batch_size))
+    if arch == "rnn":
+        module = CharGRU(vocab_size=m.vocab_size,
+                         hidden_size=m.rnn_hidden_size)
+        sample = jnp.zeros((batch_size, m.rnn_seq_len), jnp.int32)
+        return ModelDef(arch, module, sample, is_recurrent=True)
+    raise ValueError(f"Unknown architecture {arch!r}")

@@ -1,0 +1,79 @@
+"""Convex models: logistic regression, least squares, robust variants.
+
+Parity targets:
+* ``logistic_regression`` — zero-initialized linear classifier with a
+  per-dataset dims table (ref: convex/logistic_regression.py:9-83).
+* ``least_square`` — linear regression head, 1 output
+  (ref: convex/least_square.py:9-41) plus the factorized ``LinearMAFL``
+  variant (:43-67).
+* ``robust_*`` — identical but with a learnable adversarial input-noise
+  parameter initialized N(0, 0.001^2), added to the (flattened) input
+  before the linear map (ref: convex/robust_logistic_regression.py:18,32;
+  robust_least_square.py). Training performs gradient *ascent* on the
+  noise (federated/main.py:131-141); the engine finds it by its param name
+  ``"noise"``.
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedtorch_tpu.models.common import CONVEX_DIMS, REGRESSION_DIMS
+
+_FLATTEN_DATASETS = ("mnist", "cifar10", "cifar100", "fashion_mnist",
+                     "emnist", "emnist_full")
+
+
+def _noise_init(std: float = 0.001):
+    def init(rng, shape):
+        return std * jnp.asarray(
+            nn.initializers.normal(stddev=1.0)(rng, shape))
+    return init
+
+
+class LogisticRegression(nn.Module):
+    dataset: str
+    robust: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if self.dataset not in CONVEX_DIMS:
+            raise ValueError(
+                f"convex models do not support dataset {self.dataset!r}")
+        num_features, num_classes = CONVEX_DIMS[self.dataset]
+        if self.dataset in _FLATTEN_DATASETS:
+            x = x.reshape((x.shape[0], -1))
+        if self.robust:
+            noise = self.param("noise", _noise_init(), (num_features,))
+            x = x + noise
+        # Zero init matches logistic_regression.py:75-80.
+        return nn.Dense(num_classes, kernel_init=nn.initializers.zeros,
+                        bias_init=nn.initializers.zeros)(x)
+
+
+class LeastSquare(nn.Module):
+    dataset: str
+    robust: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if self.dataset not in REGRESSION_DIMS:
+            raise ValueError(
+                f"least squares does not support dataset {self.dataset!r}")
+        num_features = REGRESSION_DIMS[self.dataset]
+        if self.robust:
+            noise = self.param("noise", _noise_init(), (num_features,))
+            x = x + noise
+        return nn.Dense(1)(x)
+
+
+class LinearMAFL(nn.Module):
+    """Factorized linear model W(Z(x)) (least_square.py:43-67)."""
+    in_features: int
+    middle_features: int
+    out_features: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        z = nn.Dense(self.middle_features, use_bias=False, name="Z")(x)
+        return nn.Dense(self.out_features, use_bias=True, name="W")(z)

@@ -1,0 +1,38 @@
+"""MLP and robust MLP (ref: nonconvex/mlp.py:8-64, robust_mlp.py:9-65).
+
+Structure: N x [Dense -> BatchNorm(track_running_stats=False) -> ReLU ->
+Dropout] followed by a bias-free linear head. The robust variant adds the
+learnable input-noise parameter to the flattened input (robust_mlp.py:54).
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+
+from fedtorch_tpu.models.common import (
+    BatchStatsNorm, flat_input_size, make_norm, num_classes_of,
+)
+from fedtorch_tpu.models.linear import _noise_init
+
+
+class MLP(nn.Module):
+    dataset: str
+    num_layers: int = 2
+    hidden_size: int = 500
+    drop_rate: float = 0.0
+    robust: bool = False
+    norm: str = "bn"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1))
+        if self.robust:
+            noise = self.param("noise", _noise_init(),
+                               (flat_input_size(self.dataset),))
+            x = x + noise
+        for i in range(self.num_layers):
+            x = nn.Dense(self.hidden_size, name=f"layer{i + 1}")(x)
+            x = make_norm(self.norm)(x)
+            x = nn.relu(x)
+            x = nn.Dropout(rate=self.drop_rate, deterministic=not train)(x)
+        return nn.Dense(num_classes_of(self.dataset), use_bias=False,
+                        name="fc")(x)

@@ -1,0 +1,135 @@
+"""ResNet for CIFAR (6n+2) and ImageNet depths (ref: nonconvex/resnet.py).
+
+* CIFAR variant (resnet.py:209-257): 3x3 stem, 16/32/64 planes, three
+  stages of (size-2)//6 blocks; BasicBlock below depth 44, Bottleneck from
+  44 up; global average pool + linear head.
+* ImageNet variant (resnet.py:145-206): 7x7/2 stem + maxpool, 64/128/256/512
+  planes, depths 18/34/50/101/152.
+* The factory parses the depth out of the arch string and picks the variant
+  from the dataset family (resnet.py:260-274).
+
+NHWC + configurable norm ('bn' = batch-stats norm, 'gn' = GroupNorm; see
+models/common.py for the rationale).
+"""
+from __future__ import annotations
+
+from typing import Sequence, Type
+
+import flax.linen as nn
+
+from fedtorch_tpu.models.common import make_norm, num_classes_of
+
+
+class BasicBlock(nn.Module):
+    planes: int
+    stride: int = 1
+    norm: str = "bn"
+    expansion = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        residual = x
+        y = nn.Conv(self.planes, (3, 3), strides=(self.stride, self.stride),
+                    padding=1, use_bias=False)(x)
+        y = make_norm(self.norm)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.planes, (3, 3), padding=1, use_bias=False)(y)
+        y = make_norm(self.norm)(y)
+        if self.stride != 1 or x.shape[-1] != self.planes:
+            residual = nn.Conv(self.planes, (1, 1),
+                               strides=(self.stride, self.stride),
+                               use_bias=False)(x)
+            residual = make_norm(self.norm)(residual)
+        return nn.relu(y + residual)
+
+
+class Bottleneck(nn.Module):
+    planes: int
+    stride: int = 1
+    norm: str = "bn"
+    expansion = 4
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        residual = x
+        out_planes = self.planes * self.expansion
+        y = nn.Conv(self.planes, (1, 1), use_bias=False)(x)
+        y = make_norm(self.norm)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.planes, (3, 3), strides=(self.stride, self.stride),
+                    padding=1, use_bias=False)(y)
+        y = make_norm(self.norm)(y)
+        y = nn.relu(y)
+        y = nn.Conv(out_planes, (1, 1), use_bias=False)(y)
+        y = make_norm(self.norm)(y)
+        if self.stride != 1 or x.shape[-1] != out_planes:
+            residual = nn.Conv(out_planes, (1, 1),
+                               strides=(self.stride, self.stride),
+                               use_bias=False)(x)
+            residual = make_norm(self.norm)(residual)
+        return nn.relu(y + residual)
+
+
+class ResNetCifar(nn.Module):
+    dataset: str
+    size: int
+    norm: str = "bn"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if self.size % 6 != 2:
+            raise ValueError(f"resnet_size must be 6n+2, got {self.size}")
+        n_blocks = (self.size - 2) // 6
+        block: Type = Bottleneck if self.size >= 44 else BasicBlock
+        x = nn.Conv(16, (3, 3), padding=1, use_bias=False)(x)
+        x = make_norm(self.norm)(x)
+        x = nn.relu(x)
+        for stage, planes in enumerate((16, 32, 64)):
+            for i in range(n_blocks):
+                stride = 2 if (stage > 0 and i == 0) else 1
+                x = block(planes=planes, stride=stride, norm=self.norm)(
+                    x, train=train)
+        x = x.mean(axis=(1, 2))
+        return nn.Dense(num_classes_of(self.dataset))(x)
+
+
+class ResNetImageNet(nn.Module):
+    dataset: str
+    size: int
+    norm: str = "bn"
+
+    _PARAMS = {
+        18: (BasicBlock, (2, 2, 2, 2)),
+        34: (BasicBlock, (3, 4, 6, 3)),
+        50: (Bottleneck, (3, 4, 6, 3)),
+        101: (Bottleneck, (3, 4, 23, 3)),
+        152: (Bottleneck, (3, 8, 36, 3)),
+    }
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        block, layers = self._PARAMS[self.size]
+        x = nn.Conv(64, (7, 7), strides=(2, 2), padding=3, use_bias=False)(x)
+        x = make_norm(self.norm)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for stage, (planes, n_blocks) in enumerate(
+                zip((64, 128, 256, 512), layers)):
+            for i in range(n_blocks):
+                stride = 2 if (stage > 0 and i == 0) else 1
+                x = block(planes=planes, stride=stride, norm=self.norm)(
+                    x, train=train)
+        x = x.mean(axis=(1, 2))
+        return nn.Dense(num_classes_of(self.dataset))(x)
+
+
+def build_resnet(arch: str, dataset: str, norm: str = "bn") -> nn.Module:
+    """Factory matching resnet.py:260-274 arch-string parsing."""
+    size = int(arch.replace("resnet", ""))
+    if "cifar" in dataset or "svhn" in dataset \
+            or "downsampled_imagenet" in dataset or dataset == "stl10":
+        return ResNetCifar(dataset=dataset, size=size, norm=norm)
+    if "imagenet" in dataset:
+        return ResNetImageNet(dataset=dataset, size=size, norm=norm)
+    raise NotImplementedError(
+        f"resnet supports cifar/imagenet-family datasets, got {dataset!r}")

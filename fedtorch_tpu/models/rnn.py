@@ -1,0 +1,37 @@
+"""Char-GRU for Shakespeare (ref: nonconvex/rnn.py:7-47).
+
+Embedding -> GRU -> Linear over a character vocabulary. The reference keeps
+the hidden state as mutable module state carried across batches
+(rnn.py:26-35, truncated-BPTT style with a detach); here the carry is an
+explicit input/output so it threads through `lax.scan` (SURVEY.md §7
+'stateful RNN hidden carry'). Output is [B, T, vocab] (the reference
+permutes to [B, vocab, T] purely for torch's CrossEntropy layout).
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class CharGRU(nn.Module):
+    vocab_size: int = 86
+    hidden_size: int = 50
+    n_layers: int = 1
+
+    @nn.compact
+    def __call__(self, tokens, carry):
+        """tokens: [B, T] int; carry: [n_layers, B, hidden]."""
+        x = nn.Embed(self.vocab_size, self.hidden_size)(tokens)
+        new_carries = []
+        for layer in range(self.n_layers):
+            cell = nn.GRUCell(features=self.hidden_size,
+                              name=f"gru_l{layer}")
+            layer_carry, x = nn.RNN(cell, return_carry=True,
+                                    name=f"rnn_l{layer}")(
+                x, initial_carry=carry[layer])
+            new_carries.append(layer_carry)
+        logits = nn.Dense(self.vocab_size, name="decoder")(x)
+        return logits, jnp.stack(new_carries)
+
+    def initial_carry(self, batch_size: int):
+        return jnp.zeros((self.n_layers, batch_size, self.hidden_size))

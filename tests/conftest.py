@@ -15,3 +15,11 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+# A TPU-proxy sitecustomize hook (if present) may override jax_platforms
+# to "<proxy>,cpu" at interpreter start, which would make every test pay a
+# slow (or hung) remote-device handshake. Undo it before any jax backend
+# initializes — at conftest import time none has.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,189 @@
+"""Data layer tests: partitioners (cross-checked against the reference),
+synthetic generator, and device batching."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from fedtorch_tpu.config import (
+    DataConfig, ExperimentConfig, FederatedConfig,
+)
+from fedtorch_tpu.data import (
+    ClientData, build_federated_data, dirichlet_partition, epoch_permutation,
+    generate_synthetic, iid_partition, label_sorted_partition, sample_batch,
+    sensitive_group_partition, stack_partitions, take_batch, train_val_split,
+)
+
+
+
+class TestPartitioners:
+    def test_iid_covers_all(self):
+        parts = iid_partition(100, 4, seed=0)
+        all_idx = np.sort(np.concatenate(parts))
+        np.testing.assert_array_equal(all_idx, np.arange(100))
+        assert all(len(p) == 25 for p in parts)
+
+    def test_iid_deterministic(self):
+        p1 = iid_partition(50, 5, seed=3)
+        p2 = iid_partition(50, 5, seed=3)
+        for a, b in zip(p1, p2):
+            np.testing.assert_array_equal(a, b)
+
+    def test_label_sorted_num_classes(self):
+        labels = np.repeat(np.arange(10), 100)  # 1000 samples, 10 classes
+        parts = label_sorted_partition(labels, 10, num_class_per_client=2)
+        for p in parts:
+            client_classes = np.unique(labels[p])
+            assert len(client_classes) <= 2
+            assert len(p) == 100  # 1000/(10*2) per slice, 2 slices
+
+    def test_label_sorted_unbalanced_total(self):
+        labels = np.repeat(np.arange(10), 100)
+        parts = label_sorted_partition(labels, 10, num_class_per_client=2,
+                                       unbalanced=True)
+        sizes = np.asarray([len(p) for p in parts])
+        assert sizes.sum() <= 1000
+        assert sizes.std() > 0  # actually unbalanced
+
+    def test_dirichlet_matches_reference_sizes(self):
+        """Run the reference partitioner in-process and compare the exact
+        per-client class allocation for the same RNG draw."""
+        labels = np.repeat(np.arange(10), 50)
+        n_clients = 5
+
+        np.random.seed(7)
+        probs_ref = np.random.dirichlet(10 * [0.1 / 10], n_clients)
+        probs_ref[probs_ref * (500 // n_clients) < 10] = 0
+        col = probs_ref.sum(0)
+        col[col == 0] = 1
+        expected_sizes = (probs_ref * 50 / col).astype(int)
+
+        # our implementation uses RandomState(seed) -> same MT19937 stream
+        parts = dirichlet_partition(labels, n_clients, concentration=0.1,
+                                    seed=7)
+        for c, p in enumerate(parts):
+            counts = np.bincount(labels[p], minlength=10)
+            np.testing.assert_array_equal(counts, expected_sizes[c])
+
+    def test_dirichlet_is_skewed(self):
+        labels = np.repeat(np.arange(10), 500)
+        parts = dirichlet_partition(labels, 10, seed=1)
+        # with concentration 0.1/K, clients concentrate on ~1 class
+        for p in parts:
+            if len(p) == 0:
+                continue
+            counts = np.bincount(labels[p], minlength=10)
+            top_frac = counts.max() / max(counts.sum(), 1)
+            assert top_frac > 0.5
+
+    def test_sensitive_groups(self):
+        sensitive = np.asarray([0, 0, 0, 0, 1, 1, 1, 1])
+        parts = sensitive_group_partition(sensitive, 4)
+        for i, p in enumerate(parts):
+            group = 0 if i < 2 else 1
+            assert np.all(sensitive[p] == group)
+        with pytest.raises(ValueError):
+            sensitive_group_partition(sensitive, 3)
+
+
+class TestSynthetic:
+    def test_shapes_and_heterogeneity(self):
+        data = generate_synthetic(num_tasks=8, alpha=1.0, beta=1.0,
+                                  num_dim=20)
+        assert len(data.client_x) == 8
+        for x, y in zip(data.client_x, data.client_y):
+            assert x.shape[1] == 20
+            assert x.shape[0] == y.shape[0]
+            assert 350 <= x.shape[0] <= 800  # 0.8 * [500, 1000]
+        assert data.test_x.shape[0] > 0
+
+    def test_deterministic(self):
+        d1 = generate_synthetic(4, seed=5)
+        d2 = generate_synthetic(4, seed=5)
+        np.testing.assert_array_equal(d1.client_x[0], d2.client_x[0])
+
+    def test_regression_mode(self):
+        data = generate_synthetic(4, regression=True, num_dim=10)
+        assert data.client_y[0].dtype == np.float32
+
+
+class TestBatching:
+    def _make(self):
+        feats = np.arange(40, dtype=np.float32).reshape(20, 2)
+        labels = np.arange(20)
+        parts = [np.arange(0, 8), np.arange(8, 20)]  # sizes 8, 12
+        return stack_partitions(feats, labels, parts)
+
+    def test_stack_pads_cyclically(self):
+        cd = self._make()
+        assert cd.x.shape == (2, 12, 2)
+        assert list(cd.sizes) == [8, 12]
+        # client 0 padding repeats its own samples
+        np.testing.assert_array_equal(np.asarray(cd.y[0, 8:12]),
+                                      np.asarray(cd.y[0, :4]))
+
+    def test_epoch_permutation_covers_real_samples(self):
+        perm = epoch_permutation(jax.random.key(0), jnp.asarray(8), 12)
+        first8 = np.sort(np.asarray(perm[:8]))
+        np.testing.assert_array_equal(first8, np.arange(8))
+
+    def test_take_batch_epoch_semantics(self):
+        cd = self._make()
+        perm = epoch_permutation(jax.random.key(1), cd.sizes[0], cd.n_max)
+        seen = []
+        for step in range(2):  # 2 batches of 4 = full epoch of client 0
+            bx, by = take_batch(cd.x[0], cd.y[0], perm, cd.sizes[0],
+                                jnp.asarray(step), 4)
+            seen.extend(np.asarray(by).tolist())
+        assert sorted(seen) == list(range(8))
+
+    def test_sample_batch_in_range(self):
+        cd = self._make()
+        bx, by = sample_batch(jax.random.key(2), cd.x[0], cd.y[0],
+                              cd.sizes[0], 16)
+        assert np.asarray(by).max() < 8  # never draws padding
+
+    def test_train_val_split(self):
+        parts = [np.arange(10), np.arange(10, 30)]
+        tr, va = train_val_split(parts, 0.2, seed=0)
+        for t, v, p in zip(tr, va, parts):
+            assert len(t) + len(v) == len(p)
+            assert len(set(t) & set(v)) == 0
+        assert len(va[0]) == 2
+
+    def test_zero_size_partition_raises(self):
+        with pytest.raises(ValueError):
+            stack_partitions(np.ones((4, 2)), np.ones(4),
+                             [np.arange(4), np.zeros(0, int)])
+
+
+def test_build_federated_data_synthetic():
+    cfg = ExperimentConfig(
+        data=DataConfig(dataset="synthetic", synthetic_dim=20),
+        federated=FederatedConfig(federated=True, num_clients=6),
+    ).finalize()
+    fed = build_federated_data(cfg)
+    assert fed.train.num_clients == 6
+    assert fed.train.x.shape[-1] == 20
+    assert fed.test_x.shape[0] > 0
+    assert fed.val is None
+
+
+def test_build_federated_data_personal_split():
+    cfg = ExperimentConfig(
+        data=DataConfig(dataset="synthetic", synthetic_dim=10),
+        federated=FederatedConfig(federated=True, num_clients=4,
+                                  algorithm="apfl"),
+    ).finalize()
+    fed = build_federated_data(cfg)
+    assert fed.val is not None
+    assert fed.val.num_clients == 4
+
+
+def test_missing_dataset_clear_error(tmp_path):
+    cfg = ExperimentConfig(
+        data=DataConfig(dataset="mnist", data_dir=str(tmp_path)),
+        federated=FederatedConfig(federated=True, num_clients=2),
+    ).finalize()
+    with pytest.raises(FileNotFoundError, match="no network egress"):
+        build_federated_data(cfg)
