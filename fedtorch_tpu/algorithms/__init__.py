@@ -1,0 +1,25 @@
+"""Algorithm registry (--federated_type dispatch, main.py:29-42)."""
+from __future__ import annotations
+
+from fedtorch_tpu.algorithms.base import FedAlgorithm
+from fedtorch_tpu.algorithms.fedavg import FedAdam, FedAvg, FedProx
+
+_REGISTRY = {}
+
+
+def register(cls):
+    _REGISTRY[cls.name] = cls
+    return cls
+
+
+for _cls in (FedAvg, FedProx, FedAdam):
+    register(_cls)
+
+
+def make_algorithm(cfg) -> FedAlgorithm:
+    name = cfg.federated.algorithm
+    if name not in _REGISTRY:
+        raise ValueError(
+            f"Algorithm {name!r} is not implemented yet; available: "
+            f"{sorted(_REGISTRY)}")
+    return _REGISTRY[name](cfg)

@@ -1,0 +1,102 @@
+"""Federated algorithm interface.
+
+The reference couples each algorithm's logic across three places: aux-state
+construction (nodes/nodes.py:87-112 ``gen_aux_models``), in-loop gradient
+corrections (comms/trainings/federated/main.py:116-129), and an aggregation
+function (comms/algorithms/federated/*). Here an algorithm is one object
+with pure-function hooks; the engine (parallel/federated.py) calls them
+
+* under ``vmap`` over the client axis (aux init, grad transform, payload),
+* replicated for the server update.
+
+All hooks must be jit-traceable: static shapes, no Python control flow on
+traced values.
+"""
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from fedtorch_tpu.config import ExperimentConfig
+from fedtorch_tpu.core import optim
+from fedtorch_tpu.core.state import tree_scale
+
+
+class FedAlgorithm:
+    """Base = FedAvg behavior; subclasses override hooks."""
+
+    name = "fedavg"
+
+    def __init__(self, cfg: ExperimentConfig):
+        self.cfg = cfg
+
+    # -- state ---------------------------------------------------------
+    def init_client_aux(self, params) -> Any:
+        """Per-client aux pytree (called under vmap). () = none."""
+        return ()
+
+    def init_server_aux(self, params, num_clients: int) -> Any:
+        return ()
+
+    # -- local loop hooks (per client, inside the scan) ------------------
+    def extra_loss(self, params, server_params, client_aux) -> jnp.ndarray:
+        """Added to the batch loss (FedProx's proximal term)."""
+        return jnp.asarray(0.0)
+
+    def transform_grads(self, grads, *, params, server_params, client_aux,
+                        lr):
+        """Gradient correction before the optimizer step
+        (fedgate main.py:116-119, scaffold main.py:120-122)."""
+        return grads
+
+    # -- aggregation -----------------------------------------------------
+    def client_weights(self, server_aux, online_idx, num_online_eff,
+                       sizes) -> jnp.ndarray:
+        """Aggregation weights [k] for the gathered online clients.
+
+        ``num_online_eff`` is the reference denominator (fedavg.py:18-27):
+        |online| when client 0 is online, |online|+1 otherwise (the MPI
+        server shares rank 0 with a client). Default: uniform
+        1/num_online_eff; AFL/DRFA override with lambda weights."""
+        k = online_idx.shape[0]
+        return jnp.full((k,), 1.0) / num_online_eff
+
+    def client_payload(self, *, delta, client_aux, params, server_params,
+                       lr, local_steps, weight) -> Tuple[Any, Any]:
+        """Per-client (already-weighted) payload for the aggregation
+        collective, plus updated aux. delta = server - client."""
+        return tree_scale(delta, weight), client_aux
+
+    def server_update(self, server_params, server_opt, server_aux,
+                      payload_sum, *, online_idx, num_online_eff):
+        """Consume the summed payload; apply the dual-mode server step
+        (p -= lr_scale_at_sync * d, fedavg.py:89-94).
+
+        ``online_idx``: [k] int client ids of this round's participants;
+        ``num_online_eff``: the weighting denominator (see
+        client_weights)."""
+        new_params, new_opt = optim.server_step(
+            server_params, payload_sum, server_opt,
+            self.cfg.optim.lr_scale_at_sync, self.cfg.optim)
+        return new_params, new_opt, server_aux
+
+    def client_post(self, *, delta, client_aux, payload_sum, lr,
+                    local_steps, server_params, params, weight) -> Any:
+        """Per-client aux update that needs the aggregated payload
+        (FedGATE's gradient-tracking delta, fedgate.py:102-104). Called
+        under vmap over the online clients; ``params`` are the client's
+        local params at round end, ``lr`` its final local LR."""
+        return client_aux
+
+    # -- payload accounting ----------------------------------------------
+    def payload_scale(self) -> float:
+        """Fraction of dense float32 bytes the wire format costs
+        (1.0 dense, 0.25 int8, ...). Used for comm_bytes metrics."""
+        fed = self.cfg.federated
+        if fed.quantized:
+            return fed.quantized_bits / 32.0
+        if fed.compressed:
+            return fed.compressed_ratio
+        return 1.0

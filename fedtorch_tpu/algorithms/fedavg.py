@@ -1,0 +1,87 @@
+"""FedAvg, FedProx, and FedAdam.
+
+Parity targets:
+* FedAvg — weighted model-delta sum + server step
+  (comms/algorithms/federated/fedavg.py:11-99), with optional adaptive
+  int8/int16 quantization of the uplink payload and of the aggregated
+  downlink (fedavg.py:40-64). On TPU the "wire" is an ICI collective; the
+  quantize->sum->quantize->dequantize chain is kept in-graph so numerics
+  match the reference's lossy path.
+* FedProx — adds the proximal term mu/2 ||x - x_s||^2 to the local loss.
+  The reference implements it as a gradient correction mu*(x - x_s) added
+  before the step (federated/main.py:123-129); both forms are identical
+  for SGD, we use the gradient form.
+* FedAdam (arXiv:2003.00295) — per-layer adaptive server denominator
+  v = beta*v + (1-beta)*||d||; d /= sqrt(v)+tau (fedavg.py:81-84).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from fedtorch_tpu.algorithms.base import FedAlgorithm
+from fedtorch_tpu.core import optim
+from fedtorch_tpu.core.state import tree_scale
+from fedtorch_tpu.ops.quantize import quantize_dequantize
+
+
+class FedAvg(FedAlgorithm):
+    name = "fedavg"
+
+    def client_payload(self, *, delta, client_aux, params, server_params,
+                       lr, local_steps, weight):
+        payload = tree_scale(delta, weight)
+        if self.cfg.federated.quantized:
+            bits = self.cfg.federated.quantized_bits
+            payload = jax.tree.map(
+                lambda x: quantize_dequantize(x, bits), payload)
+        return payload, client_aux
+
+    def server_update(self, server_params, server_opt, server_aux,
+                      payload_sum, *, online_idx, num_online_eff):
+        if self.cfg.federated.quantized:
+            # downlink re-quantization of the summed delta (fedavg.py:54-64)
+            bits = self.cfg.federated.quantized_bits
+            payload_sum = jax.tree.map(
+                lambda x: quantize_dequantize(x, bits), payload_sum)
+        new_params, new_opt = optim.server_step(
+            server_params, payload_sum, server_opt,
+            self.cfg.optim.lr_scale_at_sync, self.cfg.optim)
+        return new_params, new_opt, server_aux
+
+
+class FedProx(FedAvg):
+    """FedProx = FedAvg + proximal gradient mu*(x - x_server)."""
+
+    name = "fedprox"
+
+    def transform_grads(self, grads, *, params, server_params, client_aux,
+                        lr):
+        mu = self.cfg.federated.fedprox_mu
+        return jax.tree.map(lambda g, p, s: g + mu * (p - s),
+                            grads, params, server_params)
+
+
+class FedAdam(FedAvg):
+    """Server-side adaptivity: the aggregated delta is normalized per
+    layer by a running norm estimate before the server step."""
+
+    name = "fedadam"
+
+    def init_server_aux(self, params, num_clients: int):
+        # one scalar v per parameter leaf (args.fedadam_v, comps/init)
+        return jax.tree.map(lambda p: jnp.zeros(()), params)
+
+    def server_update(self, server_params, server_opt, server_aux,
+                      payload_sum, *, online_idx, num_online_eff):
+        beta = self.cfg.federated.fedadam_beta
+        tau = self.cfg.federated.fedadam_tau
+        new_v = jax.tree.map(
+            lambda v, d: beta * v + (1 - beta) * jnp.linalg.norm(d.ravel()),
+            server_aux, payload_sum)
+        payload_sum = jax.tree.map(
+            lambda d, v: d / (jnp.sqrt(v) + tau), payload_sum, new_v)
+        new_params, new_opt = optim.server_step(
+            server_params, payload_sum, server_opt,
+            self.cfg.optim.lr_scale_at_sync, self.cfg.optim)
+        return new_params, new_opt, new_v

@@ -1,0 +1,67 @@
+"""Criterion & metrics.
+
+Parity targets: ``define_criterion`` (components/criterion.py:6-11 — MSE
+for least-square archs, CrossEntropy otherwise) and ``accuracy`` /
+``TopKAccuracy`` (components/metrics.py:21-91, incl. the rnn flag that
+flattens the time axis and per-class accuracy).
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy(logits: jnp.ndarray,
+                          labels: jnp.ndarray) -> jnp.ndarray:
+    """Mean CE over the batch (and time axis for [B, T, V] rnn logits)."""
+    if logits.ndim == 3:  # rnn: [B, T, V], labels [B, T]
+        logits = logits.reshape(-1, logits.shape[-1])
+        labels = labels.reshape(-1)
+    logp = jax.nn.log_softmax(logits)
+    nll = -jnp.take_along_axis(logp, labels[:, None].astype(jnp.int32),
+                               axis=-1)[:, 0]
+    return jnp.mean(nll)
+
+
+def mse_loss(pred: jnp.ndarray, target: jnp.ndarray) -> jnp.ndarray:
+    return jnp.mean(jnp.square(pred.reshape(-1) - target.reshape(-1)))
+
+
+def make_criterion(is_regression: bool):
+    """criterion.py:6-11 dispatch."""
+    return mse_loss if is_regression else softmax_cross_entropy
+
+
+def topk_accuracy(logits: jnp.ndarray, labels: jnp.ndarray,
+                  ks: Sequence[int] = (1,)) -> jnp.ndarray:
+    """Top-k accuracies (metrics.py:50-73). Returns [len(ks)]."""
+    if logits.ndim == 3:
+        logits = logits.reshape(-1, logits.shape[-1])
+        labels = labels.reshape(-1)
+    max_k = max(ks)
+    _, pred = jax.lax.top_k(logits, max_k)            # [B, max_k]
+    correct = pred == labels[:, None].astype(pred.dtype)
+    return jnp.stack([jnp.mean(jnp.any(correct[:, :k], axis=1)
+                               .astype(jnp.float32)) for k in ks])
+
+
+def accuracy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Top-1 accuracy in [0, 1]."""
+    return topk_accuracy(logits, labels, (1,))[0]
+
+
+def per_class_accuracy(logits: jnp.ndarray, labels: jnp.ndarray,
+                       num_classes: int):
+    """metrics.py:77-91: (correct_count, total_count) per class."""
+    pred = jnp.argmax(logits, axis=-1)
+    onehot = jax.nn.one_hot(labels, num_classes)
+    correct = (pred == labels)[:, None] * onehot
+    return correct.sum(0), onehot.sum(0)
+
+
+def metrics_topk(num_classes: int) -> Sequence[int]:
+    """define_metrics (metrics.py:8-18): (1,) for few classes, (1, 5)
+    when there are at least 5 classes."""
+    return (1, 5) if num_classes >= 5 else (1,)

@@ -1,0 +1,89 @@
+"""Training-state pytrees.
+
+The reference holds mutable per-process objects (``Client`` owns model,
+model_server, optimizer, aux models — nodes/nodes.py:43-112 — and scribbles
+runtime counters into ``args``, SURVEY.md §5.6). Here all of that is two
+immutable pytrees:
+
+* :class:`ClientState` — every array has a leading ``[num_clients]`` axis;
+  ``vmap`` over it is the reference's centered mode, sharding it over the
+  mesh is distributed mode (SURVEY.md §7).
+* :class:`ServerState` — replicated across devices; includes the PRNG key
+  and round counter, so a checkpoint of (ServerState, ClientState) resumes
+  the *exact* run — including client aux state the reference loses on
+  resume (SURVEY.md §5.4).
+"""
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class ClientState(NamedTuple):
+    """Per-client state; every leaf has leading axis [C]."""
+    params: Any        # working model copy (nodes.py:52 `model`)
+    opt: Any           # optimizer state incl. dual momentum buffers
+    aux: Any           # algorithm aux (gen_aux_models, nodes.py:87-112)
+    epoch: jnp.ndarray        # [C] float — fractional local epoch
+    local_index: jnp.ndarray  # [C] int — local step counter
+
+
+class ServerState(NamedTuple):
+    params: Any        # aggregated model (nodes.py `model_server`)
+    opt: Any           # server optimizer state (out-momentum buffers)
+    aux: Any           # server aux (control variates, fedadam_v, lambda)
+    round: jnp.ndarray        # scalar int
+    rng: jax.Array            # threaded PRNG key
+
+
+class RoundMetrics(NamedTuple):
+    """What the reference logs per round (logs/logging.py:83-117)."""
+    train_loss: jnp.ndarray   # [C] mean local loss (masked)
+    train_acc: jnp.ndarray    # [C] mean local top-1 (masked)
+    online_mask: jnp.ndarray  # [C]
+    comm_bytes: jnp.ndarray   # scalar — payload volume this round
+
+
+def tree_where(pred, on_true, on_false):
+    """Per-client select: ``pred`` is [C], leaves have leading axis C."""
+    def sel(a, b):
+        shape = (-1,) + (1,) * (a.ndim - 1)
+        return jnp.where(pred.reshape(shape).astype(bool), a, b)
+    return jax.tree.map(sel, on_true, on_false)
+
+
+def tree_weighted_sum(tree, weights):
+    """sum_i w_i * leaf[i] over the leading client axis."""
+    def ws(a):
+        shape = (-1,) + (1,) * (a.ndim - 1)
+        return jnp.sum(a * weights.reshape(shape).astype(a.dtype), axis=0)
+    return jax.tree.map(ws, tree)
+
+
+def tree_sub(a, b):
+    return jax.tree.map(jnp.subtract, a, b)
+
+
+def tree_add(a, b):
+    return jax.tree.map(jnp.add, a, b)
+
+
+def tree_scale(tree, s):
+    return jax.tree.map(lambda x: x * s, tree)
+
+
+def tree_zeros_like(tree):
+    return jax.tree.map(jnp.zeros_like, tree)
+
+
+def tree_broadcast_clients(tree, num_clients: int):
+    """Tile a replicated pytree to a leading [C] axis."""
+    return jax.tree.map(
+        lambda x: jnp.broadcast_to(x[None], (num_clients,) + x.shape), tree)
+
+
+def tree_bytes(tree) -> int:
+    """Static payload size in bytes (for comm accounting, SURVEY.md §5.1)."""
+    return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(tree))

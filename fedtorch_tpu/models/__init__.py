@@ -33,12 +33,20 @@ MODEL_NAMES = (
 )
 
 
-def _sample_flat(dataset: str, batch: int = 2):
+def _sample_flat(dataset: str, batch: int = 2, synthetic_dim: int = 60):
+    if dataset == "synthetic":
+        return jnp.zeros((batch, synthetic_dim), jnp.float32)
     return jnp.zeros((batch, flat_input_size(dataset)), jnp.float32)
 
 
 def _sample_image(dataset: str, batch: int = 2):
     return jnp.zeros((batch,) + image_shape(dataset), jnp.float32)
+
+
+def _sample_regression(dataset: str, batch: int, synthetic_dim: int):
+    dim = synthetic_dim if dataset == "synthetic" \
+        else REGRESSION_DIMS[dataset]
+    return jnp.zeros((batch, dim), jnp.float32)
 
 
 def define_model(cfg: ExperimentConfig, batch_size: int = 2) -> ModelDef:
@@ -61,29 +69,31 @@ def define_model(cfg: ExperimentConfig, batch_size: int = 2) -> ModelDef:
         return ModelDef(arch, module, _sample_image(dataset, batch_size))
     if arch == "logistic_regression":
         return ModelDef(arch, LogisticRegression(dataset=dataset),
-                        _sample_flat(dataset, batch_size))
+                        _sample_flat(dataset, batch_size, cfg.data.synthetic_dim))
     if arch == "robust_logistic_regression":
         return ModelDef(arch, LogisticRegression(dataset=dataset, robust=True),
-                        _sample_flat(dataset, batch_size),
+                        _sample_flat(dataset, batch_size, cfg.data.synthetic_dim),
                         has_noise_param=True)
     if arch == "least_square":
         return ModelDef(arch, LeastSquare(dataset=dataset),
-                        jnp.zeros((batch_size, REGRESSION_DIMS[dataset])),
+                        _sample_regression(dataset, batch_size,
+                                           cfg.data.synthetic_dim),
                         is_regression=True)
     if arch == "robust_least_square":
         return ModelDef(arch, LeastSquare(dataset=dataset, robust=True),
-                        jnp.zeros((batch_size, REGRESSION_DIMS[dataset])),
+                        _sample_regression(dataset, batch_size,
+                                           cfg.data.synthetic_dim),
                         is_regression=True, has_noise_param=True)
     if arch == "mlp":
         module = MLP(dataset=dataset, num_layers=m.mlp_num_layers,
                      hidden_size=m.mlp_hidden_size, drop_rate=m.drop_rate,
                      norm=m.norm)
-        return ModelDef(arch, module, _sample_flat(dataset, batch_size))
+        return ModelDef(arch, module, _sample_flat(dataset, batch_size, cfg.data.synthetic_dim))
     if arch == "robust_mlp":
         module = MLP(dataset=dataset, num_layers=m.mlp_num_layers,
                      hidden_size=m.mlp_hidden_size, drop_rate=m.drop_rate,
                      norm=m.norm, robust=True)
-        return ModelDef(arch, module, _sample_flat(dataset, batch_size),
+        return ModelDef(arch, module, _sample_flat(dataset, batch_size, cfg.data.synthetic_dim),
                         has_noise_param=True)
     if arch == "cnn":
         return ModelDef(arch, CNN(dataset=dataset),

@@ -40,11 +40,13 @@ class LogisticRegression(nn.Module):
         if self.dataset not in CONVEX_DIMS:
             raise ValueError(
                 f"convex models do not support dataset {self.dataset!r}")
-        num_features, num_classes = CONVEX_DIMS[self.dataset]
+        # class count from the reference dims table; feature count inferred
+        # from the input so configurable datasets (synthetic_dim) work
+        num_classes = CONVEX_DIMS[self.dataset][1]
         if self.dataset in _FLATTEN_DATASETS:
             x = x.reshape((x.shape[0], -1))
         if self.robust:
-            noise = self.param("noise", _noise_init(), (num_features,))
+            noise = self.param("noise", _noise_init(), (x.shape[-1],))
             x = x + noise
         # Zero init matches logistic_regression.py:75-80.
         return nn.Dense(num_classes, kernel_init=nn.initializers.zeros,
@@ -60,9 +62,8 @@ class LeastSquare(nn.Module):
         if self.dataset not in REGRESSION_DIMS:
             raise ValueError(
                 f"least squares does not support dataset {self.dataset!r}")
-        num_features = REGRESSION_DIMS[self.dataset]
         if self.robust:
-            noise = self.param("noise", _noise_init(), (num_features,))
+            noise = self.param("noise", _noise_init(), (x.shape[-1],))
             x = x + noise
         return nn.Dense(1)(x)
 

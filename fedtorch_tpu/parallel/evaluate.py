@@ -1,0 +1,124 @@
+"""Evaluation.
+
+Parity with ``do_validate`` (comms/utils/eval.py:41-150) and the centered
+variants (eval_centered.py): batched inference with loss + top-k accuracy,
+aggregated across clients; per-client worst/best/variance summaries
+(eval_centered.py:94-113). The reference's metric all-reduce
+(``global_average``, algorithms/distributed.py:148-161) is a masked mean
+over the client axis here.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedtorch_tpu.core.losses import make_criterion, topk_accuracy
+from fedtorch_tpu.models.common import ModelDef
+
+
+class EvalResult(NamedTuple):
+    loss: jnp.ndarray
+    top1: jnp.ndarray
+    top5: jnp.ndarray
+
+
+def _pad_batches(x: np.ndarray, y: np.ndarray, batch_size: int):
+    n = x.shape[0]
+    n_batches = max((n + batch_size - 1) // batch_size, 1)
+    pad = n_batches * batch_size - n
+    x = np.concatenate([x, x[:pad]]) if pad else x
+    y = np.concatenate([y, y[:pad]]) if pad else y
+    mask = np.concatenate([np.ones(n), np.zeros(pad)])
+    return (x.reshape((n_batches, batch_size) + x.shape[1:]),
+            y.reshape(n_batches, batch_size),
+            mask.reshape(n_batches, batch_size))
+
+
+def evaluate(model: ModelDef, params, x: np.ndarray, y: np.ndarray,
+             batch_size: int = 256) -> EvalResult:
+    """Server-side test evaluation (eval.py:83-99 inference loop),
+    scanning over batches on device with padding masks."""
+    bx, by, bm = _pad_batches(np.asarray(x), np.asarray(y), batch_size)
+
+    @jax.jit
+    def run(params, bx, by, bm):
+        def body(carry, batch):
+            xb, yb, mb = batch
+            if model.is_recurrent:
+                logits, _ = model.apply(params, xb,
+                                        carry=model.init_carry(xb.shape[0]))
+                # per-sample over the flattened time axis
+                logits = logits.reshape(-1, logits.shape[-1])
+                yb_f = yb.reshape(-1)
+                mb_f = jnp.repeat(mb, yb.shape[-1])
+            else:
+                logits = model.apply(params, xb)
+                yb_f, mb_f = yb, mb
+            # per-sample statistics masked so padding rows (duplicates of
+            # the head of the split) contribute nothing
+            if model.is_regression:
+                per = jnp.square(logits.reshape(-1) - yb_f)
+                t1 = t5 = jnp.zeros_like(per)
+            else:
+                logp = jax.nn.log_softmax(logits)
+                per = -jnp.take_along_axis(
+                    logp, yb_f[:, None].astype(jnp.int32), axis=-1)[:, 0]
+                kmax = min(5, logits.shape[-1])
+                _, pred = jax.lax.top_k(logits, kmax)
+                correct = pred == yb_f[:, None].astype(pred.dtype)
+                t1 = correct[:, 0].astype(jnp.float32)
+                t5 = jnp.any(correct, axis=1).astype(jnp.float32)
+            return carry, (jnp.sum(per * mb_f), jnp.sum(t1 * mb_f),
+                           jnp.sum(t5 * mb_f), jnp.sum(mb_f))
+
+        _, (losses, t1s, t5s, ws) = jax.lax.scan(body, 0, (bx, by, bm))
+        total = jnp.maximum(jnp.sum(ws), 1e-8)
+        return EvalResult(jnp.sum(losses) / total, jnp.sum(t1s) / total,
+                          jnp.sum(t5s) / total)
+
+    return run(params, jnp.asarray(bx), jnp.asarray(by), jnp.asarray(bm))
+
+
+def evaluate_clients(model: ModelDef, client_params, data,
+                     batch_size: int = 64, max_batches: int = 8):
+    """Per-client evaluation on per-client (val) shards: returns [C] loss
+    and accuracy, plus the worst/best/variance summary the centered mode
+    logs (eval_centered.py:94-113)."""
+    criterion = make_criterion(model.is_regression)
+    n_b = min(max_batches, max(data.n_max // batch_size, 1))
+
+    @jax.jit
+    def run(client_params, data):
+        def one(params, x, y, size):
+            def body(carry, i):
+                start = (i * batch_size) % jnp.maximum(size, 1)
+                idx = (start + jnp.arange(batch_size)) \
+                    % jnp.maximum(size, 1)
+                xb, yb = x[idx], y[idx]
+                if model.is_recurrent:
+                    logits, _ = model.apply(
+                        params, xb, carry=model.init_carry(batch_size))
+                else:
+                    logits = model.apply(params, xb)
+                loss = criterion(logits, yb)
+                acc = jnp.asarray(0.0) if model.is_regression else \
+                    topk_accuracy(logits, yb, (1,))[0]
+                return carry, (loss, acc)
+
+            _, (losses, accs) = jax.lax.scan(body, 0, jnp.arange(n_b))
+            return jnp.mean(losses), jnp.mean(accs)
+
+        return jax.vmap(one)(client_params, data.x, data.y, data.sizes)
+
+    losses, accs = run(client_params, data)
+    summary = {
+        "loss_mean": float(jnp.mean(losses)),
+        "acc_mean": float(jnp.mean(accs)),
+        "acc_worst": float(jnp.min(accs)),
+        "acc_best": float(jnp.max(accs)),
+        "acc_var": float(jnp.var(accs)),
+    }
+    return losses, accs, summary

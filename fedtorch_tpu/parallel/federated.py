@@ -1,0 +1,274 @@
+"""The federated round engine — one jitted XLA program per round.
+
+This replaces the reference's entire MPI round machinery
+(comms/trainings/federated/main.py:34-213): client sampling, server-model
+distribution, the local-SGD hot loop, per-algorithm corrections, and the
+gather/sum/broadcast aggregation — as a single pure function
+
+    round_fn(server, clients, data) -> (server', clients', metrics)
+
+compiled once and executed per communication round.
+
+Design (SURVEY.md §7):
+* Clients are a leading [C] pytree axis sharded over the mesh; ``vmap``
+  over that axis is the reference's centered mode, the sharded execution
+  is its MPI mode — one code path for both.
+* Partial participation: a static ``k = int(rate*C)`` clients are gathered
+  by index each round (the reference's per-round ``new_group`` of online
+  clients, main.py:61-65), so offline clients cost zero FLOPs. Round 0
+  forces client 0 online (main.py:62-63).
+* The local loop is a fixed-length ``lax.scan`` (K steps). Epoch-sync mode
+  converts epochs -> steps exactly like the centered runtime
+  (nodes_centered.py:47-50); heterogeneous client sizes wrap cyclically
+  within the round instead of the reference's per-client early loop exit.
+* Aggregation: payloads are weighted client-side (fedavg.py:18-34
+  delta-as-grad with rank weights) and tree-summed over the client axis —
+  a ``psum``-shaped reduction XLA lowers onto ICI. Every device applies
+  the same server step (replicated-server semantics, fedavg.py:89-97).
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from fedtorch_tpu.algorithms.base import FedAlgorithm
+from fedtorch_tpu.config import ExperimentConfig
+from fedtorch_tpu.core import optim
+from fedtorch_tpu.core.losses import accuracy, make_criterion
+from fedtorch_tpu.core.schedule import LRSchedule, compile_schedule, lr_at
+from fedtorch_tpu.core.state import (
+    ClientState, RoundMetrics, ServerState, tree_bytes, tree_sub,
+    tree_where,
+)
+from fedtorch_tpu.data.batching import ClientData, epoch_permutation, \
+    take_batch
+from fedtorch_tpu.models.common import ModelDef
+from fedtorch_tpu.parallel.mesh import client_sharding, make_mesh, \
+    replicate, shard_clients
+
+
+def participation_indices(rng: jax.Array, num_clients: int, k: int,
+                          round_idx: jnp.ndarray) -> jnp.ndarray:
+    """k online clients, uniformly without replacement
+    (misc.py:10-19 permutation sampling); round 0 forces client 0 online
+    by replacing the last slot (main.py:62-63)."""
+    perm = jax.random.permutation(rng, num_clients)
+    idx = perm[:k]
+    has0 = jnp.any(idx == 0)
+    force = (round_idx == 0) & ~has0
+    return jnp.where(force, idx.at[k - 1].set(0), idx)
+
+
+class FederatedTrainer:
+    """Builds and runs the jitted round program.
+
+    The reference's ``Client.initialize`` equivalents (init_config,
+    create_components, gen_aux_models — nodes/nodes.py:43-112) happen in
+    :meth:`init_state`; the round loop lives in :meth:`round_fn`."""
+
+    def __init__(self, cfg: ExperimentConfig, model: ModelDef,
+                 algorithm: FedAlgorithm, data: ClientData,
+                 mesh=None):
+        self.cfg = cfg
+        self.model = model
+        self.algorithm = algorithm
+        self.num_clients = data.num_clients
+        self.batch_size = cfg.data.batch_size
+
+        # static online-client count (online_client_rate, misc.py:14)
+        self.k_online = max(
+            int(cfg.federated.online_client_rate * self.num_clients), 1)
+
+        # static local-step count per round (flow_utils.py:33-40 epoch /
+        # local_step sync modes; epoch mode uses the max client size so
+        # every client completes its epochs — shorter clients wrap)
+        if cfg.federated.sync_type == "epoch":
+            nb_max = math.ceil(data.n_max / self.batch_size)
+            self.local_steps = nb_max * cfg.federated.num_epochs_per_comm
+        else:
+            self.local_steps = max(cfg.train.local_step, 1)
+
+        num_epochs = cfg.train.num_epochs or 1
+        self.schedule: LRSchedule = compile_schedule(
+            cfg.lr_schedule, cfg.optim, num_epochs,
+            world_size=self.num_clients)
+        self.criterion = make_criterion(model.is_regression)
+        self.mesh = mesh if mesh is not None else make_mesh(
+            cfg.mesh, self.num_clients)
+        self.data = shard_clients(data, self.mesh)
+        self._round_jit = jax.jit(self.round_fn, donate_argnums=(0, 1))
+
+    # -- state ----------------------------------------------------------
+    def init_state(self, rng: jax.Array) -> Tuple[ServerState, ClientState]:
+        rng, init_rng = jax.random.split(rng)
+        params = self.model.init(init_rng)
+        server = ServerState(
+            params=params,
+            opt=optim.init_opt_state(params, self.cfg.optim),
+            aux=self.algorithm.init_server_aux(params, self.num_clients),
+            round=jnp.zeros((), jnp.int32),
+            rng=rng)
+        C = self.num_clients
+
+        def one_client(_):
+            return ClientState(
+                params=params,
+                opt=optim.init_opt_state(params, self.cfg.optim),
+                aux=self.algorithm.init_client_aux(params),
+                epoch=jnp.zeros(()),
+                local_index=jnp.zeros((), jnp.int32))
+
+        clients = jax.vmap(one_client)(jnp.arange(C))
+        return replicate(server, self.mesh), \
+            shard_clients(clients, self.mesh)
+
+    # -- one communication round -----------------------------------------
+    def round_fn(self, server: ServerState, clients: ClientState,
+                 data: ClientData):
+        cfg, model, alg = self.cfg, self.model, self.algorithm
+        K, B, C = self.local_steps, self.batch_size, self.num_clients
+        rng_round = jax.random.fold_in(server.rng, server.round)
+        rng_sample, rng_train = jax.random.split(rng_round)
+
+        idx = participation_indices(rng_sample, C, self.k_online,
+                                    server.round)
+        # reference weighting (fedavg.py:18-27): the denominator counts
+        # client 0 even when offline (rank 0 doubles as the MPI server)
+        has0 = jnp.any(idx == 0).astype(jnp.float32)
+        num_online_eff = self.k_online + (1.0 - has0)
+        weights = alg.client_weights(server.aux, idx, num_online_eff,
+                                     jnp.take(data.sizes, idx))
+
+        # gather online-client state & data rows (the per-round new_group)
+        take = lambda t: jax.tree.map(lambda x: jnp.take(x, idx, axis=0), t)
+        on_clients = take(clients)
+        on_x, on_y = jnp.take(data.x, idx, axis=0), \
+            jnp.take(data.y, idx, axis=0)
+        on_sizes = jnp.take(data.sizes, idx)
+
+        def client_round(cstate: ClientState, x, y, size, weight, rng_c):
+            nb = jnp.ceil(size / B)  # batches per local epoch
+            perm = epoch_permutation(jax.random.fold_in(rng_c, 0), size,
+                                     x.shape[0])
+            server_params = server.params
+            carry0 = model.init_carry(B)
+
+            def step(carry, k):
+                params, opt, epoch, li, rnn_carry = carry
+                lr = lr_at(self.schedule, epoch)
+                bx, by = take_batch(x, y, perm, size, k, B)
+                drop_rng = jax.random.fold_in(rng_c, k + 1)
+
+                def loss_fn(p):
+                    if model.is_recurrent:
+                        logits, new_rnn = model.apply(
+                            p, bx, train=True, rng=drop_rng,
+                            carry=rnn_carry)
+                    else:
+                        logits = model.apply(p, bx, train=True,
+                                             rng=drop_rng)
+                        new_rnn = rnn_carry
+                    loss = self.criterion(logits, by)
+                    loss = loss + alg.extra_loss(p, server_params,
+                                                 cstate.aux)
+                    return loss, (logits, new_rnn)
+
+                (loss, (logits, new_rnn)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params)
+                grads = alg.transform_grads(
+                    grads, params=params, server_params=server_params,
+                    client_aux=cstate.aux, lr=lr)
+                if model.has_noise_param:
+                    # robust archs do gradient ASCENT on the adversarial
+                    # input noise (federated/main.py:131-141)
+                    grads = dict(grads)
+                    grads["noise"] = -grads["noise"]
+                params, opt = optim.local_step(params, grads, opt, lr,
+                                               cfg.optim)
+                acc = jnp.asarray(0.0) if model.is_regression \
+                    else accuracy(logits, by)
+                return (params, opt, epoch + 1.0 / nb, li + 1, new_rnn), \
+                    (loss, acc)
+
+            init = (server_params, cstate.opt, cstate.epoch,
+                    cstate.local_index, carry0)
+            (params, opt, epoch, li, _), (losses, accs) = jax.lax.scan(
+                step, init, jnp.arange(K))
+
+            delta = tree_sub(server_params, params)
+            lr_end = lr_at(self.schedule, epoch)
+            payload, aux = alg.client_payload(
+                delta=delta, client_aux=cstate.aux, params=params,
+                server_params=server_params, lr=lr_end, local_steps=K,
+                weight=weight)
+            new_state = ClientState(params=params, opt=opt, aux=aux,
+                                    epoch=epoch, local_index=li)
+            return payload, delta, new_state, (jnp.mean(losses),
+                                               jnp.mean(accs))
+
+        rngs = jax.random.split(rng_train, self.k_online)
+        payloads, deltas, new_on_clients, (losses, accs) = jax.vmap(
+            client_round)(on_clients, on_x, on_y, on_sizes, weights, rngs)
+
+        # the aggregation collective: sum over the (sharded) client axis
+        payload_sum = jax.tree.map(lambda p: jnp.sum(p, axis=0), payloads)
+
+        new_params, new_opt, new_saux = alg.server_update(
+            server.params, server.opt, server.aux, payload_sum,
+            online_idx=idx, num_online_eff=num_online_eff)
+
+        # aux updates that need the aggregated payload (FedGATE); each
+        # client sees its own end-of-round local params and final LR
+        post_aux = jax.vmap(
+            lambda d, a, w, p, e: alg.client_post(
+                delta=d, client_aux=a, payload_sum=payload_sum,
+                lr=lr_at(self.schedule, e), local_steps=K,
+                server_params=server.params, params=p, weight=w)
+        )(deltas, new_on_clients.aux, weights, new_on_clients.params,
+          new_on_clients.epoch)
+        new_on_clients = new_on_clients._replace(
+            aux=post_aux,
+            # clients leave the round holding the aggregated server model
+            # (model_server = deepcopy(model_client), fedavg.py:97)
+            params=jax.vmap(lambda _: new_params)(jnp.arange(self.k_online)))
+
+        # scatter online client state back into the full [C] axis
+        scatter = lambda full, new: jax.tree.map(
+            lambda f, n: f.at[idx].set(n), full, new)
+        new_clients = scatter(clients, new_on_clients)
+
+        mask_full = jnp.zeros((C,)).at[idx].set(1.0)
+        loss_full = jnp.zeros((C,)).at[idx].set(losses)
+        acc_full = jnp.zeros((C,)).at[idx].set(accs)
+        comm_bytes = jnp.asarray(
+            tree_bytes(server.params) * self.k_online
+            * alg.payload_scale(), jnp.float32)
+
+        new_server = ServerState(params=new_params, opt=new_opt,
+                                 aux=new_saux, round=server.round + 1,
+                                 rng=server.rng)
+        metrics = RoundMetrics(train_loss=loss_full, train_acc=acc_full,
+                               online_mask=mask_full,
+                               comm_bytes=comm_bytes)
+        return new_server, new_clients, metrics
+
+    # -- host-side round loop ---------------------------------------------
+    def run_round(self, server, clients):
+        return self._round_jit(server, clients, self.data)
+
+    def fit(self, rng: jax.Array, num_rounds: Optional[int] = None,
+            callback=None):
+        """The num_comms round loop (federated/main.py:56-211)."""
+        server, clients = self.init_state(rng)
+        rounds = num_rounds if num_rounds is not None \
+            else self.cfg.federated.num_comms
+        history = []
+        for _ in range(rounds):
+            server, clients, metrics = self.run_round(server, clients)
+            if callback is not None:
+                callback(server, clients, metrics)
+            history.append(metrics)
+        return server, clients, history

@@ -1,0 +1,65 @@
+"""Device mesh construction & sharding for the client axis.
+
+Replaces the reference's process topology (``FCGraph``,
+utils/topology.py:57-114: rank->block->device assignment over MPI
+processes) with a ``jax.sharding.Mesh``: federated clients live on a
+leading pytree axis that is sharded over the mesh's ``clients`` axis —
+each device holds ``num_clients / num_devices`` clients and the aggregation
+reduction becomes an XLA collective over ICI (SURVEY.md §2.10).
+
+Multi-host (DCN) initialization mirrors ``dist.init_process_group``
+(main.py:17) via ``jax.distributed.initialize``.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from fedtorch_tpu.config import MeshConfig
+
+
+def init_multihost(cfg: MeshConfig) -> None:
+    """DCN bring-up for real pods (no-op for single-process runs)."""
+    if cfg.coordinator_address is not None:
+        jax.distributed.initialize(
+            coordinator_address=cfg.coordinator_address,
+            num_processes=cfg.num_processes,
+            process_id=cfg.process_id)
+
+
+def make_mesh(cfg: MeshConfig, num_clients: Optional[int] = None) -> Mesh:
+    """1-D mesh over all (or the first ``num_devices``) devices.
+
+    When ``num_clients`` is given, the device count is clamped to a divisor
+    of it so the client axis shards evenly (clients_per_device >= 1 —
+    SURVEY.md §7 'clients-per-core > 1' layout)."""
+    devices = jax.devices(cfg.backend) if cfg.backend else jax.devices()
+    n = cfg.num_devices or len(devices)
+    n = min(n, len(devices))
+    if num_clients is not None:
+        while num_clients % n:
+            n -= 1
+    return Mesh(np.asarray(devices[:n]), (cfg.axis_name,))
+
+
+def client_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading client axis over the mesh."""
+    return NamedSharding(mesh, P(mesh.axis_names[0]))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_clients(tree, mesh: Mesh):
+    """Place a [C, ...] pytree with the client axis split over devices."""
+    sh = client_sharding(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, sh), tree)
+
+
+def replicate(tree, mesh: Mesh):
+    sh = replicated_sharding(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, sh), tree)
