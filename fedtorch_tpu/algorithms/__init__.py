@@ -3,6 +3,10 @@ from __future__ import annotations
 
 from fedtorch_tpu.algorithms.base import FedAlgorithm
 from fedtorch_tpu.algorithms.fedavg import FedAdam, FedAvg, FedProx
+from fedtorch_tpu.algorithms.fedgate import FedGate
+from fedtorch_tpu.algorithms.qffl import QFFL
+from fedtorch_tpu.algorithms.qsparse import Qsparse
+from fedtorch_tpu.algorithms.scaffold import Scaffold
 
 _REGISTRY = {}
 
@@ -12,7 +16,7 @@ def register(cls):
     return cls
 
 
-for _cls in (FedAvg, FedProx, FedAdam):
+for _cls in (FedAvg, FedProx, FedAdam, Scaffold, FedGate, Qsparse, QFFL):
     register(_cls)
 
 

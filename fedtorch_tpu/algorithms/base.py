@@ -28,9 +28,15 @@ class FedAlgorithm:
     """Base = FedAvg behavior; subclasses override hooks."""
 
     name = "fedavg"
+    # engine computes each online client's full-data loss on the incoming
+    # server model when set (qFFL, centered/main.py:62-72)
+    needs_full_loss = False
 
     def __init__(self, cfg: ExperimentConfig):
         self.cfg = cfg
+
+    def setup(self, data) -> None:
+        """One-time hook with the ClientData (sample-size weighting)."""
 
     # -- state ---------------------------------------------------------
     def init_client_aux(self, params) -> Any:
@@ -46,7 +52,7 @@ class FedAlgorithm:
         return jnp.asarray(0.0)
 
     def transform_grads(self, grads, *, params, server_params, client_aux,
-                        lr):
+                        server_aux, lr):
         """Gradient correction before the optimizer step
         (fedgate main.py:116-119, scaffold main.py:120-122)."""
         return grads
@@ -64,9 +70,11 @@ class FedAlgorithm:
         return jnp.full((k,), 1.0) / num_online_eff
 
     def client_payload(self, *, delta, client_aux, params, server_params,
-                       lr, local_steps, weight) -> Tuple[Any, Any]:
+                       server_aux, lr, local_steps, weight,
+                       full_loss=None) -> Tuple[Any, Any]:
         """Per-client (already-weighted) payload for the aggregation
-        collective, plus updated aux. delta = server - client."""
+        collective, plus updated aux. delta = server - client.
+        ``full_loss`` is provided when ``needs_full_loss`` is set."""
         return tree_scale(delta, weight), client_aux
 
     def server_update(self, server_params, server_opt, server_aux,

@@ -29,7 +29,7 @@ class FedAvg(FedAlgorithm):
     name = "fedavg"
 
     def client_payload(self, *, delta, client_aux, params, server_params,
-                       lr, local_steps, weight):
+                       server_aux, lr, local_steps, weight, full_loss=None):
         payload = tree_scale(delta, weight)
         if self.cfg.federated.quantized:
             bits = self.cfg.federated.quantized_bits
@@ -56,7 +56,7 @@ class FedProx(FedAvg):
     name = "fedprox"
 
     def transform_grads(self, grads, *, params, server_params, client_aux,
-                        lr):
+                        server_aux, lr):
         mu = self.cfg.federated.fedprox_mu
         return jax.tree.map(lambda g, p, s: g + mu * (p - s),
                             grads, params, server_params)

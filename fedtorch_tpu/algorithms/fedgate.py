@@ -1,0 +1,87 @@
+"""FedGATE / FedCOMGATE (arXiv:2007.01154) — gradient tracking with
+optional compressed or quantized aggregation.
+
+Parity target: ``fedgate_aggregation``
+(comms/algorithms/federated/fedgate.py:13-118) and the local correction
+(federated/main.py:116-119):
+
+* local step: ``g <- g - delta_i`` (the gradient-tracking variate);
+* wire formats (fedgate.py:33-100): adaptive-quantized weighted delta;
+  top-k compressed ``w*(delta_i + memory_i)`` with error-feedback memory
+  ``memory_i += delta_i - d`` where ``d`` is the aggregated sum
+  (fedgate.py:74-79, applied post-aggregation); or the dense weighted
+  delta;
+* tracking update after aggregation (fedgate.py:102-104):
+  ``delta_i += (delta_round_i - d) / (lr * K)`` computed before the client
+  re-syncs to the server model — here in :meth:`client_post` with the
+  aggregated payload.
+* FedCOMGATE = FedGATE + quantization (BASELINE.md config #2's ``-q``).
+"""
+from __future__ import annotations
+
+import jax
+
+from fedtorch_tpu.algorithms.base import FedAlgorithm
+from fedtorch_tpu.core import optim
+from fedtorch_tpu.core.state import tree_scale, tree_zeros_like
+from fedtorch_tpu.ops.quantize import quantize_dequantize
+from fedtorch_tpu.ops.topk import topk_roundtrip
+
+
+class FedGate(FedAlgorithm):
+    name = "fedgate"
+
+    def init_client_aux(self, params):
+        aux = {"delta": tree_zeros_like(params)}
+        if self.cfg.federated.compressed:
+            aux["memory"] = tree_zeros_like(params)
+        return aux
+
+    def transform_grads(self, grads, *, params, server_params, client_aux,
+                        server_aux, lr):
+        # gradient tracking (main.py:116-119)
+        return jax.tree.map(lambda g, d: g - d, grads, client_aux["delta"])
+
+    def client_payload(self, *, delta, client_aux, params, server_params,
+                       server_aux, lr, local_steps, weight, full_loss=None):
+        fed = self.cfg.federated
+        weighted = tree_scale(delta, weight)
+        if fed.quantized:
+            payload = jax.tree.map(
+                lambda x: quantize_dequantize(x, fed.quantized_bits),
+                weighted)
+        elif fed.compressed:
+            # g = w*delta + w*memory, top-k sparsified (fedgate.py:59-66)
+            payload = jax.tree.map(
+                lambda d, m: topk_roundtrip(d + m * weight,
+                                            fed.compressed_ratio),
+                weighted, client_aux["memory"])
+        else:
+            payload = weighted
+        return payload, client_aux
+
+    def server_update(self, server_params, server_opt, server_aux,
+                      payload_sum, *, online_idx, num_online_eff):
+        if self.cfg.federated.quantized:
+            payload_sum = jax.tree.map(
+                lambda x: quantize_dequantize(
+                    x, self.cfg.federated.quantized_bits), payload_sum)
+        new_params, new_opt = optim.server_step(
+            server_params, payload_sum, server_opt,
+            self.cfg.optim.lr_scale_at_sync, self.cfg.optim)
+        return new_params, new_opt, server_aux
+
+    def client_post(self, *, delta, client_aux, payload_sum, lr,
+                    local_steps, server_params, params, weight):
+        # tracking variate: delta_i += (delta_round_i - d)/(lr*K)
+        # (fedgate.py:102-104; delta arg here is x_s - x_i of this round)
+        new_track = jax.tree.map(
+            lambda t, dr, d: t + (dr - d) / (lr * local_steps),
+            client_aux["delta"], delta, payload_sum)
+        new_aux = dict(client_aux, delta=new_track)
+        if self.cfg.federated.compressed:
+            # error feedback (fedgate.py:78): memory_i += delta_i - d
+            new_aux["memory"] = jax.tree.map(
+                lambda m, dr, d: m + dr - d, client_aux["memory"], delta,
+                payload_sum)
+        return new_aux

@@ -1,0 +1,46 @@
+"""qFFL / q-FedAvg (arXiv:1905.10497) — fairness-weighted aggregation.
+
+Parity target: ``qffl_aggregation_centered``
+(comms/algorithms/federated/centered/qffl.py:4-33) — the reference wires
+qFFL only in centered mode (SURVEY.md §2.3):
+
+* each client's full-data loss F_k on the incoming server model scales its
+  delta: ``Delta_k = delta_k * F_k^q / lr``;
+* normalizer ``h = sum_k [ q*F_k^(q-1)*||Delta_k||^2 + F_k^q / lr ]``
+  (accumulated per-parameter in the reference; the norm is per-layer);
+* server applies ``(sum_k Delta_k) / (h + 1e-10)``.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from fedtorch_tpu.algorithms.base import FedAlgorithm
+from fedtorch_tpu.core import optim
+
+
+class QFFL(FedAlgorithm):
+    name = "qffl"
+    needs_full_loss = True
+
+    def client_payload(self, *, delta, client_aux, params, server_params,
+                       server_aux, lr, local_steps, weight, full_loss=None):
+        q = self.cfg.federated.qffl_q
+        fq = jnp.float_power(full_loss + 1e-10, q)
+        scaled = jax.tree.map(lambda d: d * fq / lr, delta)
+        # h contribution (qffl.py:20-23): per-layer squared norms of the
+        # scaled delta, plus the loss term once per client
+        sq_norms = sum(jnp.sum(jnp.square(x))
+                       for x in jax.tree.leaves(scaled))
+        h = q * jnp.float_power(full_loss + 1e-10, q - 1.0) * sq_norms \
+            + fq / lr
+        return {"delta": scaled, "h": h}, client_aux
+
+    def server_update(self, server_params, server_opt, server_aux,
+                      payload_sum, *, online_idx, num_online_eff):
+        d = jax.tree.map(lambda x: x / (payload_sum["h"] + 1e-10),
+                         payload_sum["delta"])
+        new_params, new_opt = optim.server_step(
+            server_params, d, server_opt,
+            self.cfg.optim.lr_scale_at_sync, self.cfg.optim)
+        return new_params, new_opt, server_aux

@@ -1,0 +1,75 @@
+"""SCAFFOLD (arXiv:1910.06378) — control-variate variance reduction.
+
+Parity target: the reference's *centered* implementation
+(comms/algorithms/federated/centered/scaffold.py:3-49), which is the
+faithful one — the MPI version double-applies the gathered tensor
+(scaffold.py:58-64 assigns ``cp.grad.data = d[0]`` then immediately
+overwrites it with the client's own ``t[0]`` and decrements the server
+control twice), a bug we do not reproduce.
+
+Semantics:
+* local step: ``g <- g + c - c_i`` (server minus client control,
+  federated/main.py:120-122);
+* at sync: ``c_i+ = c_i - c + (x_s - x_i)/(K*lr)`` (scaffold.py:26-27);
+* aggregation payload: weighted model delta plus the control delta
+  ``(c_i+ - c_i)/N`` (centered/scaffold.py:31-38: server control
+  accumulates the sum of control deltas over online clients divided by the
+  TOTAL client count N);
+* server: ``x_s -= scale * sum(w_i * delta_i)``; ``c += sum_i (c_i+ -
+  c_i)/N``.
+
+The control-variate pair rides the same aggregation collective as the
+model delta (the reference stacks them into one tensor per param,
+scaffold.py:38-56 — here they are just two pytree branches of the
+payload).
+"""
+from __future__ import annotations
+
+import jax
+
+from fedtorch_tpu.algorithms.base import FedAlgorithm
+from fedtorch_tpu.core import optim
+from fedtorch_tpu.core.state import tree_scale, tree_zeros_like
+
+
+class Scaffold(FedAlgorithm):
+    name = "scaffold"
+
+    def init_client_aux(self, params):
+        return {"control": tree_zeros_like(params)}
+
+    def init_server_aux(self, params, num_clients: int):
+        return {"control": tree_zeros_like(params)}
+
+    def transform_grads(self, grads, *, params, server_params, client_aux,
+                        server_aux, lr):
+        return jax.tree.map(lambda g, c, ci: g + c - ci, grads,
+                            server_aux["control"], client_aux["control"])
+
+    def client_payload(self, *, delta, client_aux, params, server_params,
+                       server_aux, lr, local_steps, weight, full_loss=None):
+        c_i = client_aux["control"]
+        # c_i+ = c_i - c + (x_s - x_i)/(K*lr); delta = x_s - x_i
+        c_new = jax.tree.map(
+            lambda ci, c, d: ci - c + d / (local_steps * lr),
+            c_i, server_aux["control"], delta)
+        control_delta = jax.tree.map(lambda cn, ci: cn - ci, c_new, c_i)
+        n_total = self.cfg.federated.num_clients
+        payload = {
+            "delta": tree_scale(delta, weight),
+            "control_delta": tree_scale(control_delta, 1.0 / n_total),
+        }
+        return payload, {"control": c_new}
+
+    def server_update(self, server_params, server_opt, server_aux,
+                      payload_sum, *, online_idx, num_online_eff):
+        new_params, new_opt = optim.server_step(
+            server_params, payload_sum["delta"], server_opt,
+            self.cfg.optim.lr_scale_at_sync, self.cfg.optim)
+        new_control = jax.tree.map(
+            lambda c, d: c + d, server_aux["control"],
+            payload_sum["control_delta"])
+        return new_params, new_opt, {"control": new_control}
+
+    def payload_scale(self) -> float:
+        return 2.0  # delta + control variate per param (scaffold.py:38)

@@ -13,16 +13,28 @@ import jax
 import jax.numpy as jnp
 
 
+def per_sample_nll(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Per-sample negative log-likelihood, [B]. For rnn-style [B, T, V]
+    logits the time axis is averaged per sample."""
+    logp = jax.nn.log_softmax(logits)
+    nll = -jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32),
+                               axis=-1)[..., 0]
+    return nll.mean(-1) if nll.ndim == 2 else nll
+
+
+def per_sample_loss(logits: jnp.ndarray, labels: jnp.ndarray,
+                    is_regression: bool) -> jnp.ndarray:
+    """Per-sample criterion value, [B] (masked reductions build on this)."""
+    if is_regression:
+        return jnp.square(logits.reshape(labels.shape[0], -1).mean(-1)
+                          - labels)
+    return per_sample_nll(logits, labels)
+
+
 def softmax_cross_entropy(logits: jnp.ndarray,
                           labels: jnp.ndarray) -> jnp.ndarray:
     """Mean CE over the batch (and time axis for [B, T, V] rnn logits)."""
-    if logits.ndim == 3:  # rnn: [B, T, V], labels [B, T]
-        logits = logits.reshape(-1, logits.shape[-1])
-        labels = labels.reshape(-1)
-    logp = jax.nn.log_softmax(logits)
-    nll = -jnp.take_along_axis(logp, labels[:, None].astype(jnp.int32),
-                               axis=-1)[:, 0]
-    return jnp.mean(nll)
+    return jnp.mean(per_sample_nll(logits, labels))
 
 
 def mse_loss(pred: jnp.ndarray, target: jnp.ndarray) -> jnp.ndarray:

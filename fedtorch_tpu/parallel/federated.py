@@ -37,7 +37,8 @@ import jax.numpy as jnp
 from fedtorch_tpu.algorithms.base import FedAlgorithm
 from fedtorch_tpu.config import ExperimentConfig
 from fedtorch_tpu.core import optim
-from fedtorch_tpu.core.losses import accuracy, make_criterion
+from fedtorch_tpu.core.losses import accuracy, make_criterion, \
+    per_sample_loss
 from fedtorch_tpu.core.schedule import LRSchedule, compile_schedule, lr_at
 from fedtorch_tpu.core.state import (
     ClientState, RoundMetrics, ServerState, tree_bytes, tree_sub,
@@ -96,6 +97,7 @@ class FederatedTrainer:
             cfg.lr_schedule, cfg.optim, num_epochs,
             world_size=self.num_clients)
         self.criterion = make_criterion(model.is_regression)
+        algorithm.setup(data)
         self.mesh = mesh if mesh is not None else make_mesh(
             cfg.mesh, self.num_clients)
         self.data = shard_clients(data, self.mesh)
@@ -156,6 +158,32 @@ class FederatedTrainer:
             server_params = server.params
             carry0 = model.init_carry(B)
 
+            full_loss = None
+            if alg.needs_full_loss:
+                # qFFL: F_k = SUM of per-batch mean losses over the
+                # client's full data on the incoming server model
+                # (centered/main.py:62-72 accumulates loss.item() per
+                # batch — the sum scales with the client's batch count)
+                n_full = -(-x.shape[0] // B)
+
+                def floss(carry, i):
+                    rows = i * B + jnp.arange(B)
+                    m = (rows < size).astype(jnp.float32)
+                    xb, yb = x[rows % x.shape[0]], y[rows % x.shape[0]]
+                    if model.is_recurrent:
+                        logits, _ = model.apply(server_params, xb,
+                                                carry=carry0)
+                    else:
+                        logits = model.apply(server_params, xb)
+                    per = per_sample_loss(logits, yb, model.is_regression)
+                    batch_mean = jnp.sum(per * m) / jnp.maximum(
+                        jnp.sum(m), 1.0)
+                    has_real = (jnp.sum(m) > 0).astype(jnp.float32)
+                    return carry, batch_mean * has_real
+
+                _, batch_means = jax.lax.scan(floss, 0, jnp.arange(n_full))
+                full_loss = jnp.sum(batch_means)
+
             def step(carry, k):
                 params, opt, epoch, li, rnn_carry = carry
                 lr = lr_at(self.schedule, epoch)
@@ -180,7 +208,7 @@ class FederatedTrainer:
                     loss_fn, has_aux=True)(params)
                 grads = alg.transform_grads(
                     grads, params=params, server_params=server_params,
-                    client_aux=cstate.aux, lr=lr)
+                    client_aux=cstate.aux, server_aux=server.aux, lr=lr)
                 if model.has_noise_param:
                     # robust archs do gradient ASCENT on the adversarial
                     # input noise (federated/main.py:131-141)
@@ -202,8 +230,9 @@ class FederatedTrainer:
             lr_end = lr_at(self.schedule, epoch)
             payload, aux = alg.client_payload(
                 delta=delta, client_aux=cstate.aux, params=params,
-                server_params=server_params, lr=lr_end, local_steps=K,
-                weight=weight)
+                server_params=server_params, server_aux=server.aux,
+                lr=lr_end, local_steps=K, weight=weight,
+                full_loss=full_loss)
             new_state = ClientState(params=params, opt=opt, aux=aux,
                                     epoch=epoch, local_index=li)
             return payload, delta, new_state, (jnp.mean(losses),

@@ -1,0 +1,215 @@
+"""Algorithm zoo tests: SCAFFOLD, FedGATE/FedCOMGATE, Qsparse, qFFL.
+
+Each algorithm gets (a) a hand-computed semantic unit test of its
+aggregation rule on tiny tensors (SURVEY.md §4 requirement a), and (b) a
+convergence smoke test through the full engine."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from fedtorch_tpu.algorithms import make_algorithm
+from fedtorch_tpu.config import (
+    DataConfig, ExperimentConfig, FederatedConfig, ModelConfig, OptimConfig,
+    TrainConfig,
+)
+from fedtorch_tpu.core.state import tree_zeros_like
+from fedtorch_tpu.data import build_federated_data
+from fedtorch_tpu.models import define_model
+from fedtorch_tpu.parallel import FederatedTrainer, evaluate
+
+
+def _cfg(algorithm, **fed_kw):
+    return ExperimentConfig(
+        federated=FederatedConfig(federated=True, num_clients=4,
+                                  algorithm=algorithm, **fed_kw),
+        optim=OptimConfig(lr=0.1, lr_scale_at_sync=1.0, weight_decay=0.0),
+    ).finalize()
+
+
+def _trainer(algorithm, lr=0.5, local_step=5, num_clients=8, rate=1.0,
+             **fed_kw):
+    cfg = ExperimentConfig(
+        data=DataConfig(dataset="synthetic", synthetic_dim=20,
+                        batch_size=32, synthetic_alpha=0.5,
+                        synthetic_beta=0.5),
+        federated=FederatedConfig(federated=True, num_clients=num_clients,
+                                  online_client_rate=rate,
+                                  algorithm=algorithm,
+                                  sync_type="local_step", **fed_kw),
+        model=ModelConfig(arch="logistic_regression"),
+        optim=OptimConfig(lr=lr, weight_decay=0.0),
+        train=TrainConfig(local_step=local_step),
+    ).finalize()
+    data = build_federated_data(cfg)
+    model = define_model(cfg, batch_size=32)
+    trainer = FederatedTrainer(cfg, model, make_algorithm(cfg), data.train)
+    return trainer, data
+
+
+def _run(trainer, rounds, seed=0):
+    server, clients = trainer.init_state(jax.random.key(seed))
+    for _ in range(rounds):
+        server, clients, metrics = trainer.run_round(server, clients)
+    return server, clients, metrics
+
+
+class TestScaffoldSemantics:
+    def test_control_variate_update_rule(self):
+        """c_i+ = c_i - c + delta/(K*lr); server c += sum(c_i+ - c_i)/N."""
+        cfg = _cfg("scaffold")
+        alg = make_algorithm(cfg)
+        params = {"w": jnp.zeros(2)}
+        caux = {"control": {"w": jnp.asarray([0.1, 0.2])}}
+        saux = {"control": {"w": jnp.asarray([0.05, 0.05])}}
+        delta = {"w": jnp.asarray([1.0, 2.0])}
+        K, lr, w = 4, 0.5, 0.25
+        payload, new_aux = alg.client_payload(
+            delta=delta, client_aux=caux, params=params,
+            server_params=params, server_aux=saux, lr=lr, local_steps=K,
+            weight=w)
+        expected_c_new = np.asarray([0.1, 0.2]) - 0.05 \
+            + np.asarray([1.0, 2.0]) / (K * lr)
+        np.testing.assert_allclose(np.asarray(new_aux["control"]["w"]),
+                                   expected_c_new, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(payload["delta"]["w"]),
+                                   np.asarray([0.25, 0.5]), rtol=1e-6)
+        # control delta divided by total client count N=4
+        np.testing.assert_allclose(
+            np.asarray(payload["control_delta"]["w"]),
+            (expected_c_new - np.asarray([0.1, 0.2])) / 4, rtol=1e-6)
+
+    def test_grad_correction(self):
+        cfg = _cfg("scaffold")
+        alg = make_algorithm(cfg)
+        g = {"w": jnp.asarray([1.0])}
+        out = alg.transform_grads(
+            g, params=None, server_params=None,
+            client_aux={"control": {"w": jnp.asarray([0.3])}},
+            server_aux={"control": {"w": jnp.asarray([0.5])}}, lr=0.1)
+        np.testing.assert_allclose(np.asarray(out["w"]), [1.2])
+
+    def test_converges(self):
+        trainer, data = _trainer("scaffold")
+        server, clients, _ = _run(trainer, 15)
+        res = evaluate(trainer.model, server.params, data.test_x,
+                       data.test_y, batch_size=128)
+        assert float(res.top1) > 0.5
+
+
+class TestFedGateSemantics:
+    def test_grad_tracking_correction(self):
+        cfg = _cfg("fedgate")
+        alg = make_algorithm(cfg)
+        g = {"w": jnp.asarray([1.0])}
+        out = alg.transform_grads(
+            g, params=None, server_params=None,
+            client_aux={"delta": {"w": jnp.asarray([0.4])}},
+            server_aux=(), lr=0.1)
+        np.testing.assert_allclose(np.asarray(out["w"]), [0.6])
+
+    def test_delta_tracking_update(self):
+        cfg = _cfg("fedgate")
+        alg = make_algorithm(cfg)
+        caux = {"delta": {"w": jnp.asarray([0.0])}}
+        new_aux = alg.client_post(
+            delta={"w": jnp.asarray([2.0])}, client_aux=caux,
+            payload_sum={"w": jnp.asarray([1.5])}, lr=0.5, local_steps=4,
+            server_params=None, params=None, weight=0.25)
+        # delta_i += (2.0 - 1.5)/(0.5*4) = 0.25
+        np.testing.assert_allclose(np.asarray(new_aux["delta"]["w"]),
+                                   [0.25])
+
+    def test_compressed_error_feedback(self):
+        cfg = _cfg("fedgate", compressed=True, compressed_ratio=1.0)
+        alg = make_algorithm(cfg)
+        caux = alg.init_client_aux({"w": jnp.zeros(4)})
+        assert "memory" in caux
+        new_aux = alg.client_post(
+            delta={"w": jnp.asarray([1.0, 0.0, 0.0, 0.0])},
+            client_aux=caux,
+            payload_sum={"w": jnp.asarray([0.5, 0.0, 0.0, 0.0])},
+            lr=0.5, local_steps=2, server_params=None, params=None,
+            weight=0.5)
+        np.testing.assert_allclose(np.asarray(new_aux["memory"]["w"]),
+                                   [0.5, 0, 0, 0])
+
+    @pytest.mark.parametrize("kw", [
+        {},
+        {"quantized": True, "quantized_bits": 8},     # FedCOMGATE
+        {"compressed": True, "compressed_ratio": 1.0},
+    ])
+    def test_converges(self, kw):
+        trainer, data = _trainer("fedgate", **kw)
+        server, clients, _ = _run(trainer, 15)
+        res = evaluate(trainer.model, server.params, data.test_x,
+                       data.test_y, batch_size=128)
+        assert float(res.top1) > 0.45, kw
+
+
+class TestQsparseSemantics:
+    def test_sample_size_weights(self):
+        cfg = _cfg("qsparse")
+        alg = make_algorithm(cfg)
+
+        class FakeData:
+            sizes = jnp.asarray([10, 30, 60])
+        alg.setup(FakeData)
+        w = alg.client_weights((), jnp.asarray([0, 2]), 2.0,
+                               jnp.asarray([10, 60]))
+        np.testing.assert_allclose(np.asarray(w), [0.1, 0.6])
+
+    def test_converges(self):
+        trainer, data = _trainer("qsparse", compressed_ratio=1.0)
+        server, clients, _ = _run(trainer, 15)
+        res = evaluate(trainer.model, server.params, data.test_x,
+                       data.test_y, batch_size=128)
+        assert float(res.top1) > 0.45
+
+
+class TestQFFLSemantics:
+    def test_h_normalization_hand_computed(self):
+        cfg = _cfg("qffl", qffl_q=1.0)
+        alg = make_algorithm(cfg)
+        delta = {"w": jnp.asarray([2.0])}
+        payload, _ = alg.client_payload(
+            delta=delta, client_aux=(), params=None, server_params=None,
+            server_aux=(), lr=0.5, local_steps=1, weight=1.0,
+            full_loss=jnp.asarray(0.5))
+        # scaled = 2 * 0.5^1 / 0.5 = 2 ; h = 1*0.5^0*4 + 0.5/0.5 = 5
+        np.testing.assert_allclose(np.asarray(payload["delta"]["w"]), [2.0],
+                                   rtol=1e-5)
+        assert float(payload["h"]) == pytest.approx(5.0, rel=1e-5)
+
+    def test_q_zero_reduces_to_sum(self):
+        """q=0: scaled = delta/lr, h = num_clients/lr -> average*...)"""
+        cfg = _cfg("qffl", qffl_q=0.0)
+        alg = make_algorithm(cfg)
+        payload, _ = alg.client_payload(
+            delta={"w": jnp.asarray([1.0])}, client_aux=(), params=None,
+            server_params=None, server_aux=(), lr=0.5, local_steps=1,
+            weight=1.0, full_loss=jnp.asarray(7.7))
+        np.testing.assert_allclose(np.asarray(payload["delta"]["w"]), [2.0])
+        assert float(payload["h"]) == pytest.approx(2.0)
+
+    def test_converges(self):
+        trainer, data = _trainer("qffl", qffl_q=1.0, lr=0.5)
+        server, clients, _ = _run(trainer, 15)
+        res = evaluate(trainer.model, server.params, data.test_x,
+                       data.test_y, batch_size=128)
+        assert float(res.top1) > 0.45
+
+
+class TestScaffoldBeatsFedAvgOnHeterogeneous:
+    def test_variance_reduction_effect(self):
+        """SCAFFOLD's control variates should not hurt on skewed data
+        (sanity that the correction wiring has the right sign)."""
+        t_avg, data = _trainer("fedavg", lr=0.3, local_step=10)
+        t_sca, _ = _trainer("scaffold", lr=0.3, local_step=10)
+        s_avg, _, _ = _run(t_avg, 12, seed=11)
+        s_sca, _, _ = _run(t_sca, 12, seed=11)
+        r_avg = evaluate(t_avg.model, s_avg.params, data.test_x,
+                         data.test_y, batch_size=128)
+        r_sca = evaluate(t_sca.model, s_sca.params, data.test_x,
+                         data.test_y, batch_size=128)
+        assert float(r_sca.top1) > float(r_avg.top1) - 0.15

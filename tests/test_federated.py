@@ -102,7 +102,8 @@ class TestFedAvgAggregation:
         delta = {"w": jnp.asarray([1.0, 2.0])}
         payload, _ = alg.client_payload(
             delta=delta, client_aux=(), params=None, server_params=None,
-            lr=0.1, local_steps=5, weight=jnp.asarray(0.25))
+            server_aux=(), lr=0.1, local_steps=5,
+            weight=jnp.asarray(0.25))
         np.testing.assert_allclose(np.asarray(payload["w"]), [0.25, 0.5])
 
 
