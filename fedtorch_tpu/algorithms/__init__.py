@@ -1,9 +1,12 @@
 """Algorithm registry (--federated_type dispatch, main.py:29-42)."""
 from __future__ import annotations
 
+from fedtorch_tpu.algorithms.apfl import APFL
 from fedtorch_tpu.algorithms.base import FedAlgorithm
 from fedtorch_tpu.algorithms.fedavg import FedAdam, FedAvg, FedProx
 from fedtorch_tpu.algorithms.fedgate import FedGate
+from fedtorch_tpu.algorithms.perfedavg import PerFedAvg
+from fedtorch_tpu.algorithms.perfedme import PerFedMe
 from fedtorch_tpu.algorithms.qffl import QFFL
 from fedtorch_tpu.algorithms.qsparse import Qsparse
 from fedtorch_tpu.algorithms.scaffold import Scaffold
@@ -16,7 +19,8 @@ def register(cls):
     return cls
 
 
-for _cls in (FedAvg, FedProx, FedAdam, Scaffold, FedGate, Qsparse, QFFL):
+for _cls in (FedAvg, FedProx, FedAdam, Scaffold, FedGate, Qsparse, QFFL,
+             APFL, PerFedMe, PerFedAvg):
     register(_cls)
 
 

@@ -1,0 +1,121 @@
+"""APFL — Adaptive Personalized Federated Learning (arXiv:2003.13461).
+
+Parity target: the APFL training loop
+(comms/trainings/federated/apfl.py:33-180):
+
+* per batch, TWO steps (apfl.py:95-116): a standard local-model step, then
+  a personalized-model step on the mixed output
+  ``alpha*personal(x) + (1-alpha)*local(x)`` (inference_personal,
+  eval.py:31-39) using the *updated* local model, with gradients taken
+  w.r.t. the personal parameters only;
+* optional adaptive alpha on the first batch of each round
+  (apfl.py:119-123 -> flow_utils.py:240-250):
+  ``grad_alpha = sum_l <p_personal - p_local, alpha*g_personal +
+  (1-alpha)*g_local> + 0.02*alpha``; ``alpha <- clip(alpha - eta*
+  grad_alpha, 0, 1)``, then averaged across the online clients. (The
+  reference's global_average passes count=n_nodes per client, shrinking
+  alpha by ~n — an apparent bug; we use the plain mean over online
+  clients.)
+* aggregation: plain FedAvg on the local model (apfl.py:151-152); the
+  personal model and its optimizer state persist per client.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from fedtorch_tpu.algorithms.fedavg import FedAvg
+from fedtorch_tpu.core import optim
+
+
+class APFL(FedAvg):
+    name = "apfl"
+
+    def bind(self, model, criterion):
+        super().bind(model, criterion)
+        if model.is_recurrent:
+            raise NotImplementedError(
+                "apfl does not support recurrent models (the reference's "
+                "inference_personal, eval.py:31-39, has no hidden-state "
+                "handling either)")
+
+    def init_client_aux(self, params):
+        return {
+            "personal": jax.tree.map(jnp.array, params),
+            "personal_opt": optim.init_opt_state(params, self.cfg.optim),
+            "alpha": jnp.asarray(self.cfg.federated.personal_alpha),
+            # pre-aggregation local model for personalized evaluation
+            # (the reference validates personal models BEFORE the sync,
+            # apfl.py:138-144)
+            "local_snapshot": jax.tree.map(jnp.array, params),
+        }
+
+    def _mixed_loss(self, personal_params, local_params, alpha, bx, by,
+                    rng):
+        train = rng is not None
+        out_p = self.model.apply(personal_params, bx, train=train, rng=rng)
+        out_l = self.model.apply(local_params, bx, train=train, rng=rng)
+        return self.criterion(alpha * out_p + (1 - alpha) * out_l, by)
+
+    def pre_round(self, on_aux, *, server, x, y, sizes, lr, rng):
+        """Adaptive alpha (apfl.py:119-123): per-client update on the
+        round's first batch at the scheduled LR, then averaged across the
+        online clients. The alpha gradient is evaluated deterministically
+        (no dropout noise)."""
+        if not self.cfg.federated.adaptive_alpha:
+            return on_aux
+        B = self.cfg.data.batch_size
+
+        def one(aux, xc, yc, eta):
+            bx, by = xc[:B], yc[:B]
+            alpha = aux["alpha"]
+            g_p = jax.grad(self._mixed_loss, argnums=0)(
+                aux["personal"], server.params, alpha, bx, by, None)
+            g_l = jax.grad(self._mixed_loss, argnums=1)(
+                aux["personal"], server.params, alpha, bx, by, None)
+            # grad_alpha = sum <p_pers - p_local, alpha*g_p + (1-a)*g_l>
+            grad_alpha = sum(
+                jnp.vdot(pp - pl, alpha * gp + (1 - alpha) * gl)
+                for pp, pl, gp, gl in zip(
+                    jax.tree.leaves(aux["personal"]),
+                    jax.tree.leaves(server.params),
+                    jax.tree.leaves(g_p), jax.tree.leaves(g_l)))
+            grad_alpha = grad_alpha + 0.02 * alpha
+            new_alpha = jnp.clip(alpha - eta * grad_alpha, 0.0, 1.0)
+            return dict(aux, alpha=new_alpha)
+
+        new_aux = jax.vmap(one)(on_aux, x, y, lr)
+        mean_alpha = jnp.mean(new_aux["alpha"])
+        return dict(new_aux,
+                    alpha=jnp.full_like(new_aux["alpha"], mean_alpha))
+
+    def local_step(self, *, params, opt, client_aux, rnn_carry,
+                   server_params, server_aux, bx, by, bval_x, bval_y, lr,
+                   rng, step_idx, local_index):
+        # 1) standard local step (apfl.py:95-103)
+        params, opt, client_aux, rnn_carry, loss, acc = super().local_step(
+            params=params, opt=opt, client_aux=client_aux,
+            rnn_carry=rnn_carry, server_params=server_params,
+            server_aux=server_aux, bx=bx, by=by, bval_x=bval_x,
+            bval_y=bval_y, lr=lr, rng=rng, step_idx=step_idx,
+            local_index=local_index)
+        # 2) personal step on the mixed output with the UPDATED local
+        #    model (apfl.py:105-116)
+        alpha = client_aux["alpha"]
+        rng_p = jax.random.fold_in(rng, 1)
+        g_p = jax.grad(self._mixed_loss, argnums=0)(
+            client_aux["personal"], params, alpha, bx, by, rng_p)
+        personal, p_opt = optim.local_step(
+            client_aux["personal"], g_p, client_aux["personal_opt"], lr,
+            self.cfg.optim)
+        new_aux = dict(client_aux, personal=personal, personal_opt=p_opt)
+        return params, opt, new_aux, rnn_carry, loss, acc
+
+    def client_payload(self, *, delta, client_aux, params, server_params,
+                       server_aux, lr, local_steps, weight, full_loss=None):
+        payload, aux = super().client_payload(
+            delta=delta, client_aux=client_aux, params=params,
+            server_params=server_params, server_aux=server_aux, lr=lr,
+            local_steps=local_steps, weight=weight, full_loss=full_loss)
+        # keep the trained pre-sync local model for personalized eval
+        return payload, dict(aux, local_snapshot=params)

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 
 from fedtorch_tpu.config import ExperimentConfig
 from fedtorch_tpu.core import optim
+from fedtorch_tpu.core.losses import accuracy  # noqa: F401 (hook use)
 from fedtorch_tpu.core.state import tree_scale
 
 
@@ -32,11 +33,25 @@ class FedAlgorithm:
     # server model when set (qFFL, centered/main.py:62-72)
     needs_full_loss = False
 
+    # set when the algorithm consumes a per-step validation batch
+    # (PerFedAvg's MAML outer step; requires cfg.federated.personal)
+    needs_val_batch = False
+
     def __init__(self, cfg: ExperimentConfig):
         self.cfg = cfg
+        self.model = None
+        self.criterion = None
+        # set by the engine before tracing (static round length)
+        self.local_steps_per_round = max(cfg.train.local_step, 1)
 
     def setup(self, data) -> None:
         """One-time hook with the ClientData (sample-size weighting)."""
+
+    def bind(self, model, criterion) -> None:
+        """Engine provides the model/criterion so algorithm hooks can run
+        forwards/backwards of their own (personal models)."""
+        self.model = model
+        self.criterion = criterion
 
     # -- state ---------------------------------------------------------
     def init_client_aux(self, params) -> Any:
@@ -56,6 +71,50 @@ class FedAlgorithm:
         """Gradient correction before the optimizer step
         (fedgate main.py:116-119, scaffold main.py:120-122)."""
         return grads
+
+    def pre_round(self, on_aux, *, server, x, y, sizes, lr, rng):
+        """Once per round, on the gathered [k] online-client aux, OUTSIDE
+        the vmapped local loop — the place for cross-client work like
+        APFL's globally-averaged adaptive alpha (apfl.py:119-123).
+        ``lr``: [k] scheduled LR at each online client's current epoch."""
+        return on_aux
+
+    def local_step(self, *, params, opt, client_aux, rnn_carry,
+                   server_params, server_aux, bx, by, bval_x, bval_y, lr,
+                   rng, step_idx, local_index):
+        """One local training step (the hot loop body,
+        federated/main.py:83-155). The base implements the standard
+        inference -> backward -> per-algorithm grad correction ->
+        dual-mode SGD step; personalized algorithms override or extend.
+
+        Returns (params, opt, client_aux, rnn_carry, loss, acc)."""
+        model, criterion, cfg = self.model, self.criterion, self.cfg
+
+        def loss_fn(p):
+            if model.is_recurrent:
+                logits, new_rnn = model.apply(p, bx, train=True, rng=rng,
+                                              carry=rnn_carry)
+            else:
+                logits = model.apply(p, bx, train=True, rng=rng)
+                new_rnn = rnn_carry
+            loss = criterion(logits, by)
+            loss = loss + self.extra_loss(p, server_params, client_aux)
+            return loss, (logits, new_rnn)
+
+        (loss, (logits, new_rnn)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        grads = self.transform_grads(
+            grads, params=params, server_params=server_params,
+            client_aux=client_aux, server_aux=server_aux, lr=lr)
+        if model.has_noise_param:
+            # robust archs: gradient ASCENT on the adversarial input
+            # noise (federated/main.py:131-141)
+            grads = dict(grads)
+            grads["noise"] = -grads["noise"]
+        params, opt = optim.local_step(params, grads, opt, lr, cfg.optim)
+        acc = jnp.asarray(0.0) if model.is_regression \
+            else accuracy(logits, by)
+        return params, opt, client_aux, new_rnn, loss, acc
 
     # -- aggregation -----------------------------------------------------
     def client_weights(self, server_aux, online_idx, num_online_eff,

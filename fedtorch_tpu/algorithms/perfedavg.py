@@ -1,0 +1,63 @@
+"""PerFedAvg — Personalized FedAvg via first-order MAML (arXiv:2002.07948).
+
+Parity target: the perfedavg branch of the centered loop
+(comms/trainings/federated/centered/main.py:156-170): after each standard
+local step (the MAML inner step at the scheduled LR), one more SGD step is
+taken on a batch from the client's validation split at the fixed outer
+rate ``perfedavg_beta`` (scheduler.py lr_external override). Aggregation
+is plain FedAvg; personalization is the adapted model itself.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from fedtorch_tpu.algorithms.fedavg import FedAvg
+from fedtorch_tpu.core import optim
+
+
+class PerFedAvg(FedAvg):
+    name = "perfedavg"
+    needs_val_batch = True
+
+    def bind(self, model, criterion):
+        super().bind(model, criterion)
+        if model.is_recurrent:
+            raise NotImplementedError(
+                "perfedavg does not support recurrent models")
+
+    def init_client_aux(self, params):
+        # pre-aggregation adapted model — the personalized artifact
+        return {"local_snapshot": jax.tree.map(jnp.array, params)}
+
+    def client_payload(self, *, delta, client_aux, params, server_params,
+                       server_aux, lr, local_steps, weight, full_loss=None):
+        payload, aux = super().client_payload(
+            delta=delta, client_aux=client_aux, params=params,
+            server_params=server_params, server_aux=server_aux, lr=lr,
+            local_steps=local_steps, weight=weight, full_loss=full_loss)
+        return payload, dict(aux, local_snapshot=params)
+
+    def local_step(self, *, params, opt, client_aux, rnn_carry,
+                   server_params, server_aux, bx, by, bval_x, bval_y, lr,
+                   rng, step_idx, local_index):
+        # inner step (centered/main.py:127-141 standard step)
+        params, opt, client_aux, rnn_carry, loss, acc = super().local_step(
+            params=params, opt=opt, client_aux=client_aux,
+            rnn_carry=rnn_carry, server_params=server_params,
+            server_aux=server_aux, bx=bx, by=by, bval_x=bval_x,
+            bval_y=bval_y, lr=lr, rng=rng, step_idx=step_idx,
+            local_index=local_index)
+
+        # outer step at beta on the val batch (centered/main.py:156-170)
+        beta = self.cfg.federated.perfedavg_beta
+        rng_v = jax.random.fold_in(rng, 2)
+
+        def vloss(p):
+            logits = self.model.apply(p, bval_x, train=True, rng=rng_v)
+            return self.criterion(logits, bval_y)
+
+        g = jax.grad(vloss)(params)
+        params, opt = optim.local_step(params, g, opt, beta,
+                                       self.cfg.optim)
+        return params, opt, client_aux, rnn_carry, loss, acc

@@ -1,0 +1,79 @@
+"""PerFedMe / pFedMe — Moreau-envelope personalization (arXiv:2006.08848).
+
+Parity target: ``train_and_validate_perfedme_centered``
+(comms/trainings/federated/centered/perfedme.py:25-167):
+
+* every batch updates the PERSONAL model theta with the prox gradient
+  ``grad f(theta) + lambda*(theta - w)`` (perfedme.py:99-101);
+* every 5 local steps (and at sync) the local copy of the global model w
+  takes a step along ``lambda*(w - theta)`` through the main optimizer
+  (perfedme.py:115-124);
+* aggregation: plain FedAvg on w; theta persists per client.
+
+Reported train loss/accuracy come from the personal model's inference
+(perfedme.py:93), matching the reference tracker.
+
+Stability note: the prox step multiplies by ``lr * lambda``; with the
+reference default lambda=15 the personal model oscillates unless
+``lr < 1/lambda`` (e.g. lr 0.05 works, 0.3 diverges). Same bound applies
+to the reference implementation.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from fedtorch_tpu.algorithms.fedavg import FedAvg
+from fedtorch_tpu.core import optim
+from fedtorch_tpu.core.losses import accuracy
+
+
+class PerFedMe(FedAvg):
+    name = "perfedme"
+
+    def bind(self, model, criterion):
+        super().bind(model, criterion)
+        if model.is_recurrent:
+            raise NotImplementedError(
+                "perfedme does not support recurrent models")
+
+    def init_client_aux(self, params):
+        return {
+            "personal": jax.tree.map(jnp.array, params),
+            "personal_opt": optim.init_opt_state(params, self.cfg.optim),
+        }
+
+    def local_step(self, *, params, opt, client_aux, rnn_carry,
+                   server_params, server_aux, bx, by, bval_x, bval_y, lr,
+                   rng, step_idx, local_index):
+        lam = self.cfg.federated.perfedme_lambda
+        model, criterion = self.model, self.criterion
+
+        def ploss(pp):
+            logits = model.apply(pp, bx, train=True, rng=rng)
+            return criterion(logits, by), logits
+
+        (loss, logits), g_p = jax.value_and_grad(ploss, has_aux=True)(
+            client_aux["personal"])
+        # prox-to-global gradient (perfedme.py:99-101)
+        g_p = jax.tree.map(lambda g, p, w: g + lam * (p - w), g_p,
+                           client_aux["personal"], params)
+        personal, p_opt = optim.local_step(
+            client_aux["personal"], g_p, client_aux["personal_opt"], lr,
+            self.cfg.optim)
+
+        # every 5 steps or at sync (= last step of the round,
+        # perfedme.py:115-124): pull w toward theta
+        is_last = step_idx == self.local_steps_per_round - 1
+        update_w = ((local_index + 1) % 5 == 0) | is_last
+        g_w = jax.tree.map(lambda w, p: lam * (w - p), params, personal)
+        new_params, new_opt = optim.local_step(params, g_w, opt, lr,
+                                               self.cfg.optim)
+        sel = lambda a, b: jnp.where(update_w, a, b)
+        params = jax.tree.map(sel, new_params, params)
+        opt = jax.tree.map(sel, new_opt, opt)
+
+        acc = jnp.asarray(0.0) if model.is_regression \
+            else accuracy(logits, by)
+        new_aux = dict(client_aux, personal=personal, personal_opt=p_opt)
+        return params, opt, new_aux, rnn_carry, loss, acc

@@ -1,4 +1,6 @@
-from fedtorch_tpu.parallel.evaluate import evaluate, evaluate_clients  # noqa: F401
+from fedtorch_tpu.parallel.evaluate import (  # noqa: F401
+    evaluate, evaluate_clients, evaluate_personal,
+)
 from fedtorch_tpu.parallel.federated import FederatedTrainer  # noqa: F401
 from fedtorch_tpu.parallel.mesh import (  # noqa: F401
     client_sharding, init_multihost, make_mesh, replicate,

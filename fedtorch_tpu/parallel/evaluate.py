@@ -83,26 +83,33 @@ def evaluate(model: ModelDef, params, x: np.ndarray, y: np.ndarray,
 
 
 def evaluate_clients(model: ModelDef, client_params, data,
-                     batch_size: int = 64, max_batches: int = 8):
+                     batch_size: int = 64, max_batches: int = 8,
+                     apply_fn=None):
     """Per-client evaluation on per-client (val) shards: returns [C] loss
     and accuracy, plus the worst/best/variance summary the centered mode
-    logs (eval_centered.py:94-113)."""
+    logs (eval_centered.py:94-113).
+
+    ``apply_fn(per_client_params, x) -> logits`` overrides the default
+    forward (used by personalized evaluation); ``client_params`` is any
+    pytree with a leading client axis that apply_fn understands."""
     criterion = make_criterion(model.is_regression)
     n_b = min(max_batches, max(data.n_max // batch_size, 1))
+
+    if apply_fn is None:
+        if model.is_recurrent:
+            apply_fn = lambda p, x: model.apply(
+                p, x, carry=model.init_carry(x.shape[0]))[0]
+        else:
+            apply_fn = lambda p, x: model.apply(p, x)
 
     @jax.jit
     def run(client_params, data):
         def one(params, x, y, size):
             def body(carry, i):
-                start = (i * batch_size) % jnp.maximum(size, 1)
-                idx = (start + jnp.arange(batch_size)) \
+                idx = (i * batch_size + jnp.arange(batch_size)) \
                     % jnp.maximum(size, 1)
                 xb, yb = x[idx], y[idx]
-                if model.is_recurrent:
-                    logits, _ = model.apply(
-                        params, xb, carry=model.init_carry(batch_size))
-                else:
-                    logits = model.apply(params, xb)
+                logits = apply_fn(params, xb)
                 loss = criterion(logits, yb)
                 acc = jnp.asarray(0.0) if model.is_regression else \
                     topk_accuracy(logits, yb, (1,))[0]
@@ -122,3 +129,35 @@ def evaluate_clients(model: ModelDef, client_params, data,
         "acc_var": float(jnp.var(accs)),
     }
     return losses, accs, summary
+
+
+def evaluate_personal(model: ModelDef, client_aux, client_params, data,
+                      algorithm_name: str, batch_size: int = 64,
+                      max_batches: int = 8):
+    """Per-client evaluation of personalized models — evaluated against
+    the PRE-aggregation local model snapshot the algorithms keep in aux
+    (the reference validates personal models before the sync,
+    apfl.py:138-144).
+
+    * apfl: mixed output alpha*personal + (1-alpha)*local_snapshot
+      (inference_personal, eval.py:31-39)
+    * perfedme: the personal model theta
+    * perfedavg: the adapted pre-sync local model
+    """
+    if algorithm_name == "apfl":
+        eval_params = (client_aux["personal"],
+                       client_aux["local_snapshot"], client_aux["alpha"])
+        apply_fn = lambda ps, x: ps[2] * model.apply(ps[0], x) \
+            + (1 - ps[2]) * model.apply(ps[1], x)
+    elif algorithm_name == "perfedme":
+        eval_params = client_aux["personal"]
+        apply_fn = None
+    elif algorithm_name == "perfedavg":
+        eval_params = client_aux["local_snapshot"]
+        apply_fn = None
+    else:
+        eval_params = client_params
+        apply_fn = None
+    return evaluate_clients(model, eval_params, data,
+                            batch_size=batch_size,
+                            max_batches=max_batches, apply_fn=apply_fn)

@@ -37,8 +37,7 @@ import jax.numpy as jnp
 from fedtorch_tpu.algorithms.base import FedAlgorithm
 from fedtorch_tpu.config import ExperimentConfig
 from fedtorch_tpu.core import optim
-from fedtorch_tpu.core.losses import accuracy, make_criterion, \
-    per_sample_loss
+from fedtorch_tpu.core.losses import make_criterion, per_sample_loss
 from fedtorch_tpu.core.schedule import LRSchedule, compile_schedule, lr_at
 from fedtorch_tpu.core.state import (
     ClientState, RoundMetrics, ServerState, tree_bytes, tree_sub,
@@ -72,12 +71,16 @@ class FederatedTrainer:
 
     def __init__(self, cfg: ExperimentConfig, model: ModelDef,
                  algorithm: FedAlgorithm, data: ClientData,
-                 mesh=None):
+                 val_data: Optional[ClientData] = None, mesh=None):
         self.cfg = cfg
         self.model = model
         self.algorithm = algorithm
         self.num_clients = data.num_clients
         self.batch_size = cfg.data.batch_size
+        if algorithm.needs_val_batch and val_data is None:
+            raise ValueError(
+                f"{algorithm.name} needs per-client validation batches; "
+                "pass FederatedData.val (cfg.federated.personal builds it)")
 
         # static online-client count (online_client_rate, misc.py:14)
         self.k_online = max(
@@ -98,9 +101,13 @@ class FederatedTrainer:
             world_size=self.num_clients)
         self.criterion = make_criterion(model.is_regression)
         algorithm.setup(data)
+        algorithm.bind(model, self.criterion)
+        algorithm.local_steps_per_round = self.local_steps
         self.mesh = mesh if mesh is not None else make_mesh(
             cfg.mesh, self.num_clients)
         self.data = shard_clients(data, self.mesh)
+        self.val_data = shard_clients(val_data, self.mesh) \
+            if val_data is not None else None
         self._round_jit = jax.jit(self.round_fn, donate_argnums=(0, 1))
 
     # -- state ----------------------------------------------------------
@@ -129,7 +136,7 @@ class FederatedTrainer:
 
     # -- one communication round -----------------------------------------
     def round_fn(self, server: ServerState, clients: ClientState,
-                 data: ClientData):
+                 data: ClientData, val_data: Optional[ClientData] = None):
         cfg, model, alg = self.cfg, self.model, self.algorithm
         K, B, C = self.local_steps, self.batch_size, self.num_clients
         rng_round = jax.random.fold_in(server.rng, server.round)
@@ -150,8 +157,25 @@ class FederatedTrainer:
         on_x, on_y = jnp.take(data.x, idx, axis=0), \
             jnp.take(data.y, idx, axis=0)
         on_sizes = jnp.take(data.sizes, idx)
+        if val_data is not None:
+            on_vx = jnp.take(val_data.x, idx, axis=0)
+            on_vy = jnp.take(val_data.y, idx, axis=0)
+            on_vsizes = jnp.take(val_data.sizes, idx)
+        else:
+            # unused placeholders keep the vmapped signature static
+            on_vx, on_vy = on_x[:, :1], on_y[:, :1]
+            on_vsizes = jnp.ones_like(on_sizes)
 
-        def client_round(cstate: ClientState, x, y, size, weight, rng_c):
+        # cross-client pre-round hook (APFL adaptive alpha, apfl.py:119-123)
+        on_lrs = jax.vmap(lambda e: lr_at(self.schedule, e))(
+            on_clients.epoch)
+        on_aux0 = alg.pre_round(on_clients.aux, server=server, x=on_x,
+                                y=on_y, sizes=on_sizes, lr=on_lrs,
+                                rng=rng_round)
+        on_clients = on_clients._replace(aux=on_aux0)
+
+        def client_round(cstate: ClientState, x, y, vx, vy, size, vsize,
+                         weight, rng_c):
             nb = jnp.ceil(size / B)  # batches per local epoch
             perm = epoch_permutation(jax.random.fold_in(rng_c, 0), size,
                                      x.shape[0])
@@ -184,52 +208,36 @@ class FederatedTrainer:
                 _, batch_means = jax.lax.scan(floss, 0, jnp.arange(n_full))
                 full_loss = jnp.sum(batch_means)
 
+            vperm = epoch_permutation(jax.random.fold_in(rng_c, 7), vsize,
+                                      vx.shape[0])
+
             def step(carry, k):
-                params, opt, epoch, li, rnn_carry = carry
+                params, opt, aux, epoch, li, rnn_carry = carry
                 lr = lr_at(self.schedule, epoch)
                 bx, by = take_batch(x, y, perm, size, k, B)
+                if alg.needs_val_batch:
+                    bval_x, bval_y = take_batch(vx, vy, vperm, vsize, k, B)
+                else:
+                    bval_x = bval_y = None
                 drop_rng = jax.random.fold_in(rng_c, k + 1)
+                params, opt, aux, rnn_carry, loss, acc = alg.local_step(
+                    params=params, opt=opt, client_aux=aux,
+                    rnn_carry=rnn_carry, server_params=server_params,
+                    server_aux=server.aux, bx=bx, by=by, bval_x=bval_x,
+                    bval_y=bval_y, lr=lr, rng=drop_rng, step_idx=k,
+                    local_index=li)
+                return (params, opt, aux, epoch + 1.0 / nb, li + 1,
+                        rnn_carry), (loss, acc)
 
-                def loss_fn(p):
-                    if model.is_recurrent:
-                        logits, new_rnn = model.apply(
-                            p, bx, train=True, rng=drop_rng,
-                            carry=rnn_carry)
-                    else:
-                        logits = model.apply(p, bx, train=True,
-                                             rng=drop_rng)
-                        new_rnn = rnn_carry
-                    loss = self.criterion(logits, by)
-                    loss = loss + alg.extra_loss(p, server_params,
-                                                 cstate.aux)
-                    return loss, (logits, new_rnn)
-
-                (loss, (logits, new_rnn)), grads = jax.value_and_grad(
-                    loss_fn, has_aux=True)(params)
-                grads = alg.transform_grads(
-                    grads, params=params, server_params=server_params,
-                    client_aux=cstate.aux, server_aux=server.aux, lr=lr)
-                if model.has_noise_param:
-                    # robust archs do gradient ASCENT on the adversarial
-                    # input noise (federated/main.py:131-141)
-                    grads = dict(grads)
-                    grads["noise"] = -grads["noise"]
-                params, opt = optim.local_step(params, grads, opt, lr,
-                                               cfg.optim)
-                acc = jnp.asarray(0.0) if model.is_regression \
-                    else accuracy(logits, by)
-                return (params, opt, epoch + 1.0 / nb, li + 1, new_rnn), \
-                    (loss, acc)
-
-            init = (server_params, cstate.opt, cstate.epoch,
+            init = (server_params, cstate.opt, cstate.aux, cstate.epoch,
                     cstate.local_index, carry0)
-            (params, opt, epoch, li, _), (losses, accs) = jax.lax.scan(
-                step, init, jnp.arange(K))
+            (params, opt, aux, epoch, li, _), (losses, accs) = \
+                jax.lax.scan(step, init, jnp.arange(K))
 
             delta = tree_sub(server_params, params)
             lr_end = lr_at(self.schedule, epoch)
             payload, aux = alg.client_payload(
-                delta=delta, client_aux=cstate.aux, params=params,
+                delta=delta, client_aux=aux, params=params,
                 server_params=server_params, server_aux=server.aux,
                 lr=lr_end, local_steps=K, weight=weight,
                 full_loss=full_loss)
@@ -240,7 +248,8 @@ class FederatedTrainer:
 
         rngs = jax.random.split(rng_train, self.k_online)
         payloads, deltas, new_on_clients, (losses, accs) = jax.vmap(
-            client_round)(on_clients, on_x, on_y, on_sizes, weights, rngs)
+            client_round)(on_clients, on_x, on_y, on_vx, on_vy, on_sizes,
+                          on_vsizes, weights, rngs)
 
         # the aggregation collective: sum over the (sharded) client axis
         payload_sum = jax.tree.map(lambda p: jnp.sum(p, axis=0), payloads)
@@ -286,7 +295,7 @@ class FederatedTrainer:
 
     # -- host-side round loop ---------------------------------------------
     def run_round(self, server, clients):
-        return self._round_jit(server, clients, self.data)
+        return self._round_jit(server, clients, self.data, self.val_data)
 
     def fit(self, rng: jax.Array, num_rounds: Optional[int] = None,
             callback=None):
