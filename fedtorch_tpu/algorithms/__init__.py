@@ -1,8 +1,10 @@
 """Algorithm registry (--federated_type dispatch, main.py:29-42)."""
 from __future__ import annotations
 
+from fedtorch_tpu.algorithms.afl import AFL
 from fedtorch_tpu.algorithms.apfl import APFL
 from fedtorch_tpu.algorithms.base import FedAlgorithm
+from fedtorch_tpu.algorithms.drfa import DRFA
 from fedtorch_tpu.algorithms.fedavg import FedAdam, FedAvg, FedProx
 from fedtorch_tpu.algorithms.fedgate import FedGate
 from fedtorch_tpu.algorithms.perfedavg import PerFedAvg
@@ -13,6 +15,9 @@ from fedtorch_tpu.algorithms.scaffold import Scaffold
 
 _REGISTRY = {}
 
+# inner aggregations DRFA can wrap (drfa.py:178-193)
+DRFA_INNER = ("fedavg", "fedgate", "scaffold")
+
 
 def register(cls):
     _REGISTRY[cls.name] = cls
@@ -20,7 +25,7 @@ def register(cls):
 
 
 for _cls in (FedAvg, FedProx, FedAdam, Scaffold, FedGate, Qsparse, QFFL,
-             APFL, PerFedMe, PerFedAvg):
+             APFL, PerFedMe, PerFedAvg, AFL):
     register(_cls)
 
 
@@ -29,5 +34,11 @@ def make_algorithm(cfg) -> FedAlgorithm:
     if name not in _REGISTRY:
         raise ValueError(
             f"Algorithm {name!r} is not implemented yet; available: "
-            f"{sorted(_REGISTRY)}")
+            f"{sorted(_REGISTRY)} (+ drfa wrapper)")
+    if cfg.federated.drfa:
+        if name not in DRFA_INNER:
+            raise ValueError(
+                f"DRFA wraps one of {DRFA_INNER}, got {name!r} "
+                "(ref: drfa.py:178-193)")
+        return DRFA(cfg, inner=_REGISTRY[name](cfg))
     return _REGISTRY[name](cfg)

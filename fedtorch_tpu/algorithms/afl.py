@@ -1,0 +1,49 @@
+"""AFL — Agnostic Federated Learning (arXiv:1902.00146).
+
+Parity targets: ``afl_aggregation``
+(comms/algorithms/federated/afl.py:9-61) and the AFL loop's dual update
+(trainings/federated/afl.py:157-170):
+
+* aggregation weights are the dual variable itself: ``w_i = lambda_i``
+  (afl.py:11-14 — NOT normalized by the online count);
+* each client reports its (single-step: AFL forces local_step=1,
+  parameters.py:249-251) batch loss; the server ascends
+  ``lambda += gamma * loss_vector`` over the online clients, projects onto
+  the simplex, floors at 1e-3 and renormalizes once (afl loop:160-170 —
+  same rule as DRFA's, via ops.simplex.project_simplex_floor);
+* clients are sampled uniformly; lambda only drives weighting + duals.
+
+lambda lives in the server aux [C]; the reference initializes it uniform
+(nodes.py gen_aux_models).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from fedtorch_tpu.algorithms.base import FedAlgorithm
+from fedtorch_tpu.core import optim
+from fedtorch_tpu.ops.simplex import project_simplex_floor
+
+
+class AFL(FedAlgorithm):
+    name = "afl"
+
+    def init_server_aux(self, params, num_clients: int):
+        return {"lambda": jnp.full((num_clients,), 1.0 / num_clients)}
+
+    def client_weights(self, server_aux, online_idx, num_online_eff,
+                       sizes):
+        return jnp.take(server_aux["lambda"], online_idx)
+
+    def server_update(self, server_params, server_opt, server_aux,
+                      payload_sum, *, online_idx, num_online_eff,
+                      client_losses=None):
+        new_params, new_opt = optim.server_step(
+            server_params, payload_sum, server_opt,
+            self.cfg.optim.lr_scale_at_sync, self.cfg.optim)
+        # dual ascent on the online clients' losses (afl loop:160-170)
+        lam = server_aux["lambda"]
+        loss_vec = jnp.zeros_like(lam).at[online_idx].set(client_losses)
+        lam = lam + self.cfg.federated.drfa_gamma * loss_vec
+        lam = project_simplex_floor(lam, floor=1e-3)
+        return new_params, new_opt, {"lambda": lam}

@@ -25,6 +25,16 @@ from fedtorch_tpu.core.losses import accuracy  # noqa: F401 (hook use)
 from fedtorch_tpu.core.state import tree_scale
 
 
+def num_online_effective(online_idx: jnp.ndarray) -> jnp.ndarray:
+    """The reference's weighting denominator (fedavg.py:18-27): |online|
+    when client 0 is online, |online|+1 otherwise (the MPI server shares
+    rank 0 with a client). Shared by the engine and DRFA's second
+    sampling phase."""
+    k = online_idx.shape[0]
+    has0 = jnp.any(online_idx == 0).astype(jnp.float32)
+    return k + (1.0 - has0)
+
+
 class FedAlgorithm:
     """Base = FedAvg behavior; subclasses override hooks."""
 
@@ -41,8 +51,12 @@ class FedAlgorithm:
         self.cfg = cfg
         self.model = None
         self.criterion = None
-        # set by the engine before tracing (static round length)
+        # set by the engine before tracing (static round length / static
+        # online-client count)
         self.local_steps_per_round = max(cfg.train.local_step, 1)
+        self.k_online = max(
+            int(cfg.federated.online_client_rate
+                * cfg.federated.num_clients), 1)
 
     def setup(self, data) -> None:
         """One-time hook with the ClientData (sample-size weighting)."""
@@ -71,6 +85,20 @@ class FedAlgorithm:
         """Gradient correction before the optimizer step
         (fedgate main.py:116-119, scaffold main.py:120-122)."""
         return grads
+
+    def participation(self, rng, num_clients: int, k: int, round_idx,
+                      server_aux):
+        """Override to control online-client sampling; return a [k] index
+        array or None for the engine's default uniform sampling
+        (misc.py:10-19). DRFA samples from the lambda distribution
+        (misc.py:30-37)."""
+        return None
+
+    def post_round_global(self, server, data, rng):
+        """Optional second phase after aggregation with full data access
+        (DRFA's kth-model loss collection + dual update,
+        drfa.py:215-249). Returns the updated ServerState."""
+        return server
 
     def pre_round(self, on_aux, *, server, x, y, sizes, lr, rng):
         """Once per round, on the gathered [k] online-client aux, OUTSIDE
@@ -137,13 +165,15 @@ class FedAlgorithm:
         return tree_scale(delta, weight), client_aux
 
     def server_update(self, server_params, server_opt, server_aux,
-                      payload_sum, *, online_idx, num_online_eff):
+                      payload_sum, *, online_idx, num_online_eff,
+                      client_losses=None):
         """Consume the summed payload; apply the dual-mode server step
         (p -= lr_scale_at_sync * d, fedavg.py:89-94).
 
         ``online_idx``: [k] int client ids of this round's participants;
-        ``num_online_eff``: the weighting denominator (see
-        client_weights)."""
+        ``num_online_eff``: the weighting denominator (client_weights);
+        ``client_losses``: [k] mean local train loss per online client
+        (AFL's dual ascent consumes these, afl.py:39-47)."""
         new_params, new_opt = optim.server_step(
             server_params, payload_sum, server_opt,
             self.cfg.optim.lr_scale_at_sync, self.cfg.optim)

@@ -1,0 +1,173 @@
+"""DRFA — Distributionally Robust Federated Averaging (NeurIPS 2020).
+
+Parity target: the DRFA round (comms/trainings/federated/drfa.py:38-258,
+SURVEY.md §3.5), a minimax wrapper around an inner aggregation algorithm
+(fedavg / fedgate / scaffold — drfa.py:178-193):
+
+* lambda [C] initialized proportional to client sample sizes
+  (drfa.py:51-57);
+* online clients sampled FROM the lambda distribution without replacement
+  (misc.py:30-37) — here via Gumbel top-k, which is the same
+  sequential-renormalization scheme numpy uses;
+* aggregation weights: ``lambda_i * C / num_online`` (fedavg.py:27's
+  lambda_weight branch), applied through the inner algorithm's payload;
+* a shared random step index k ~ U[1, K) is broadcast each round
+  (drfa.py:93-99); every client snapshots its model after k local steps
+  (drfa.py:109-111) and the snapshots are averaged with 1/|online|
+  (aggregate_models_virtual, misc.py:39-52);
+* second phase (drfa.py:215-249): a SECOND uniformly-sampled client set
+  computes the kth-average model's loss on one random local batch; the
+  dual ascends ``lambda += gamma * K * loss_vector * (C/num_online2)``,
+  projects onto the simplex and floors at 1e-3.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from fedtorch_tpu.algorithms.base import FedAlgorithm, \
+    num_online_effective
+from fedtorch_tpu.core.losses import per_sample_loss
+from fedtorch_tpu.core.state import tree_scale, tree_zeros_like
+from fedtorch_tpu.data.batching import sample_batch
+from fedtorch_tpu.ops.simplex import project_simplex_floor
+
+
+class DRFA(FedAlgorithm):
+    name = "drfa"
+
+    def __init__(self, cfg, inner: FedAlgorithm):
+        super().__init__(cfg)
+        self.inner = inner
+
+    # -- delegation helpers ------------------------------------------------
+    def setup(self, data):
+        self.inner.setup(data)
+        self._sizes = jnp.asarray(data.sizes, jnp.float32)
+
+    def bind(self, model, criterion):
+        super().bind(model, criterion)
+        self.inner.bind(model, criterion)
+        if model.is_recurrent:
+            raise NotImplementedError("drfa does not support rnn models")
+
+    # -- state -------------------------------------------------------------
+    def init_client_aux(self, params):
+        return {"inner": self.inner.init_client_aux(params),
+                "kth": tree_zeros_like(params),
+                "k_rand": jnp.zeros((), jnp.int32)}
+
+    def init_server_aux(self, params, num_clients: int):
+        lam = self._sizes / jnp.sum(self._sizes)  # drfa.py:51-57
+        return {"inner": self.inner.init_server_aux(params, num_clients),
+                "lambda": lam,
+                "kth_avg": tree_zeros_like(params)}
+
+    # -- sampling & weighting ---------------------------------------------
+    def participation(self, rng, num_clients, k, round_idx, server_aux):
+        # Gumbel top-k == sampling w/o replacement from lambda
+        # (misc.py:30-37 np.random.choice p=lambda)
+        lam = jnp.clip(server_aux["lambda"], 1e-12, None)
+        g = jax.random.gumbel(rng, (num_clients,))
+        return jax.lax.top_k(jnp.log(lam) + g, k)[1]
+
+    def client_weights(self, server_aux, online_idx, num_online_eff,
+                       sizes):
+        lam = jnp.take(server_aux["lambda"], online_idx)
+        n = self.cfg.federated.num_clients
+        return lam * n / num_online_eff  # fedavg.py:27
+
+    # -- local loop --------------------------------------------------------
+    def pre_round(self, on_aux, *, server, x, y, sizes, lr, rng):
+        K = max(self.local_steps_per_round, 2)
+        k_rand = jax.random.randint(jax.random.fold_in(rng, 11), (), 1, K)
+        k_full = jnp.full(on_aux["k_rand"].shape, k_rand, jnp.int32)
+        inner_aux = self.inner.pre_round(
+            on_aux["inner"], server=server._replace(
+                aux=server.aux["inner"]),
+            x=x, y=y, sizes=sizes, lr=lr, rng=rng)
+        return dict(on_aux, inner=inner_aux, k_rand=k_full)
+
+    def transform_grads(self, grads, **kw):
+        kw["server_aux"] = kw["server_aux"]["inner"]
+        kw["client_aux"] = kw["client_aux"]["inner"]
+        return self.inner.transform_grads(grads, **kw)
+
+    def local_step(self, *, params, opt, client_aux, rnn_carry,
+                   server_params, server_aux, bx, by, bval_x, bval_y, lr,
+                   rng, step_idx, local_index):
+        params, opt, inner_aux, rnn_carry, loss, acc = \
+            self.inner.local_step(
+                params=params, opt=opt, client_aux=client_aux["inner"],
+                rnn_carry=rnn_carry, server_params=server_params,
+                server_aux=server_aux["inner"], bx=bx, by=by,
+                bval_x=bval_x, bval_y=bval_y, lr=lr, rng=rng,
+                step_idx=step_idx, local_index=local_index)
+        # snapshot after k local steps (drfa.py:109-111)
+        hit = (step_idx + 1) == client_aux["k_rand"]
+        kth = jax.tree.map(lambda s, p: jnp.where(hit, p, s),
+                           client_aux["kth"], params)
+        new_aux = dict(client_aux, inner=inner_aux, kth=kth)
+        return params, opt, new_aux, rnn_carry, loss, acc
+
+    # -- aggregation -------------------------------------------------------
+    def client_payload(self, *, delta, client_aux, params, server_params,
+                       server_aux, lr, local_steps, weight, full_loss=None):
+        inner_payload, inner_aux = self.inner.client_payload(
+            delta=delta, client_aux=client_aux["inner"], params=params,
+            server_params=server_params, server_aux=server_aux["inner"],
+            lr=lr, local_steps=local_steps, weight=weight,
+            full_loss=full_loss)
+        payload = {"inner": inner_payload,
+                   # aggregate_models_virtual: 1/|online| model average
+                   "kth": tree_scale(client_aux["kth"],
+                                     1.0 / self.k_online)}
+        return payload, dict(client_aux, inner=inner_aux)
+
+    def server_update(self, server_params, server_opt, server_aux,
+                      payload_sum, *, online_idx, num_online_eff,
+                      client_losses=None):
+        new_params, new_opt, inner_saux = self.inner.server_update(
+            server_params, server_opt, server_aux["inner"],
+            payload_sum["inner"], online_idx=online_idx,
+            num_online_eff=num_online_eff, client_losses=client_losses)
+        new_aux = dict(server_aux, inner=inner_saux,
+                       kth_avg=payload_sum["kth"])
+        return new_params, new_opt, new_aux
+
+    def client_post(self, *, delta, client_aux, payload_sum, lr,
+                    local_steps, server_params, params, weight):
+        inner_aux = self.inner.client_post(
+            delta=delta, client_aux=client_aux["inner"],
+            payload_sum=payload_sum["inner"], lr=lr,
+            local_steps=local_steps, server_params=server_params,
+            params=params, weight=weight)
+        return dict(client_aux, inner=inner_aux)
+
+    # -- dual update (second phase, drfa.py:215-249) -----------------------
+    def post_round_global(self, server, data, rng):
+        C = self.cfg.federated.num_clients
+        k = self.k_online
+        B = self.cfg.data.batch_size
+        rng_idx, rng_batch = jax.random.split(rng)
+        idx2 = jax.random.permutation(rng_idx, C)[:k]  # uniform sampling
+        kth_avg = server.aux["kth_avg"]
+        model = self.model
+
+        def one_loss(ci, rng_c):
+            x, y = data.x[ci], data.y[ci]
+            bx, by = sample_batch(rng_c, x, y, data.sizes[ci], B)
+            logits = model.apply(kth_avg, bx)
+            return jnp.mean(per_sample_loss(logits, by,
+                                            model.is_regression))
+
+        losses = jax.vmap(one_loss)(idx2, jax.random.split(rng_batch, k))
+        num_online2 = num_online_effective(idx2)
+        lam = server.aux["lambda"]
+        # loss_tensor scaled by n/num_online (drfa.py:239-241)
+        loss_vec = jnp.zeros_like(lam).at[idx2].set(
+            losses * C / num_online2)
+        lam = lam + self.cfg.federated.drfa_gamma \
+            * self.local_steps_per_round * loss_vec
+        lam = project_simplex_floor(lam, floor=1e-3)
+        return server._replace(aux=dict(server.aux, **{"lambda": lam}))

@@ -38,7 +38,8 @@ class FedAvg(FedAlgorithm):
         return payload, client_aux
 
     def server_update(self, server_params, server_opt, server_aux,
-                      payload_sum, *, online_idx, num_online_eff):
+                      payload_sum, *, online_idx, num_online_eff,
+                      client_losses=None):
         if self.cfg.federated.quantized:
             # downlink re-quantization of the summed delta (fedavg.py:54-64)
             bits = self.cfg.federated.quantized_bits
@@ -73,7 +74,8 @@ class FedAdam(FedAvg):
         return jax.tree.map(lambda p: jnp.zeros(()), params)
 
     def server_update(self, server_params, server_opt, server_aux,
-                      payload_sum, *, online_idx, num_online_eff):
+                      payload_sum, *, online_idx, num_online_eff,
+                      client_losses=None):
         beta = self.cfg.federated.fedadam_beta
         tau = self.cfg.federated.fedadam_tau
         new_v = jax.tree.map(

@@ -61,7 +61,8 @@ class FedGate(FedAlgorithm):
         return payload, client_aux
 
     def server_update(self, server_params, server_opt, server_aux,
-                      payload_sum, *, online_idx, num_online_eff):
+                      payload_sum, *, online_idx, num_online_eff,
+                      client_losses=None):
         if self.cfg.federated.quantized:
             payload_sum = jax.tree.map(
                 lambda x: quantize_dequantize(

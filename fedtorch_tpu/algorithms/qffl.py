@@ -37,7 +37,8 @@ class QFFL(FedAlgorithm):
         return {"delta": scaled, "h": h}, client_aux
 
     def server_update(self, server_params, server_opt, server_aux,
-                      payload_sum, *, online_idx, num_online_eff):
+                      payload_sum, *, online_idx, num_online_eff,
+                      client_losses=None):
         d = jax.tree.map(lambda x: x / (payload_sum["h"] + 1e-10),
                          payload_sum["delta"])
         new_params, new_opt = optim.server_step(

@@ -62,7 +62,8 @@ class Scaffold(FedAlgorithm):
         return payload, {"control": c_new}
 
     def server_update(self, server_params, server_opt, server_aux,
-                      payload_sum, *, online_idx, num_online_eff):
+                      payload_sum, *, online_idx, num_online_eff,
+                      client_losses=None):
         new_params, new_opt = optim.server_step(
             server_params, payload_sum["delta"], server_opt,
             self.cfg.optim.lr_scale_at_sync, self.cfg.optim)

@@ -2,6 +2,9 @@ from fedtorch_tpu.parallel.evaluate import (  # noqa: F401
     evaluate, evaluate_clients, evaluate_personal,
 )
 from fedtorch_tpu.parallel.federated import FederatedTrainer  # noqa: F401
+from fedtorch_tpu.parallel.local_sgd import (  # noqa: F401
+    LocalSGDTrainer, build_local_sgd,
+)
 from fedtorch_tpu.parallel.mesh import (  # noqa: F401
     client_sharding, init_multihost, make_mesh, replicate,
     replicated_sharding, shard_clients,

@@ -34,7 +34,8 @@ from typing import Any, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from fedtorch_tpu.algorithms.base import FedAlgorithm
+from fedtorch_tpu.algorithms.base import FedAlgorithm, \
+    num_online_effective
 from fedtorch_tpu.config import ExperimentConfig
 from fedtorch_tpu.core import optim
 from fedtorch_tpu.core.losses import make_criterion, per_sample_loss
@@ -103,6 +104,7 @@ class FederatedTrainer:
         algorithm.setup(data)
         algorithm.bind(model, self.criterion)
         algorithm.local_steps_per_round = self.local_steps
+        algorithm.k_online = self.k_online
         self.mesh = mesh if mesh is not None else make_mesh(
             cfg.mesh, self.num_clients)
         self.data = shard_clients(data, self.mesh)
@@ -142,12 +144,12 @@ class FederatedTrainer:
         rng_round = jax.random.fold_in(server.rng, server.round)
         rng_sample, rng_train = jax.random.split(rng_round)
 
-        idx = participation_indices(rng_sample, C, self.k_online,
-                                    server.round)
-        # reference weighting (fedavg.py:18-27): the denominator counts
-        # client 0 even when offline (rank 0 doubles as the MPI server)
-        has0 = jnp.any(idx == 0).astype(jnp.float32)
-        num_online_eff = self.k_online + (1.0 - has0)
+        idx = alg.participation(rng_sample, C, self.k_online, server.round,
+                                server.aux)
+        if idx is None:
+            idx = participation_indices(rng_sample, C, self.k_online,
+                                        server.round)
+        num_online_eff = num_online_effective(idx)
         weights = alg.client_weights(server.aux, idx, num_online_eff,
                                      jnp.take(data.sizes, idx))
 
@@ -256,7 +258,8 @@ class FederatedTrainer:
 
         new_params, new_opt, new_saux = alg.server_update(
             server.params, server.opt, server.aux, payload_sum,
-            online_idx=idx, num_online_eff=num_online_eff)
+            online_idx=idx, num_online_eff=num_online_eff,
+            client_losses=losses)
 
         # aux updates that need the aggregated payload (FedGATE); each
         # client sees its own end-of-round local params and final LR
@@ -288,6 +291,9 @@ class FederatedTrainer:
         new_server = ServerState(params=new_params, opt=new_opt,
                                  aux=new_saux, round=server.round + 1,
                                  rng=server.rng)
+        # second global phase with data access (DRFA dual update)
+        new_server = alg.post_round_global(
+            new_server, data, jax.random.fold_in(rng_round, 99))
         metrics = RoundMetrics(train_loss=loss_full, train_acc=acc_full,
                                online_mask=mask_full,
                                comm_bytes=comm_bytes)

@@ -1,0 +1,130 @@
+"""Distributed local-SGD mode (non-federated).
+
+Parity target: ``train_and_validate`` (comms/trainings/distributed.py:
+23-134) + ``aggregate_gradients`` (comms/algorithms/distributed.py:
+108-142): every worker trains on its own shard and periodically
+all-reduces model deltas — sync every ``local_steps[epoch]`` steps, where
+the per-epoch counts come from the warmup-capable sync scheme
+(distributed.py:17-106).
+
+Differences from the federated engine it reuses:
+* all workers are always online (no sampling);
+* weights are exactly 1/n when ``avg_model`` else 1 (the SUM-only mode,
+  distributed.py:124-126) — no rank-0 denominator quirk;
+* the per-round step count follows the sync schedule, so rounds with
+  different K compile once each and are cached;
+* optional per-epoch reshuffle re-partitions the data across workers
+  (distributed.py:129-134), rebuilding the device arrays host-side.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedtorch_tpu.algorithms.fedavg import FedAvg
+from fedtorch_tpu.config import ExperimentConfig
+from fedtorch_tpu.core.sync import local_steps_from_config
+from fedtorch_tpu.data.batching import ClientData, stack_partitions
+from fedtorch_tpu.data.partition import iid_partition
+from fedtorch_tpu.models.common import ModelDef
+from fedtorch_tpu.parallel.federated import FederatedTrainer
+from fedtorch_tpu.parallel.mesh import shard_clients
+
+
+class LocalSGDAggregation(FedAvg):
+    """aggregate_gradients weighting (distributed.py:124-126)."""
+
+    name = "localsgd"
+
+    def client_weights(self, server_aux, online_idx, num_online_eff,
+                       sizes):
+        n = self.cfg.federated.num_clients
+        w = 1.0 / n if self.cfg.train.avg_model else 1.0
+        return jnp.full((online_idx.shape[0],), w)
+
+
+class LocalSGDTrainer(FederatedTrainer):
+    """Local-SGD over the worker axis; workers == 'clients' on the mesh."""
+
+    def __init__(self, cfg: ExperimentConfig, model: ModelDef,
+                 data: ClientData, mesh=None, raw_splits=None):
+        if cfg.federated.online_client_rate != 1.0:
+            import dataclasses
+            cfg = dataclasses.replace(
+                cfg, federated=dataclasses.replace(
+                    cfg.federated, online_client_rate=1.0))
+        super().__init__(cfg, model, LocalSGDAggregation(cfg), data,
+                         mesh=mesh)
+        self.steps_schedule = local_steps_from_config(cfg)
+        self._round_cache = {}
+        self._raw_splits = raw_splits  # for reshuffle_per_epoch
+
+    def _round_with_steps(self, K: int):
+        if K not in self._round_cache:
+            def fn(server, clients, data, val_data):
+                old = self.local_steps
+                old_alg = self.algorithm.local_steps_per_round
+                self.local_steps = K
+                self.algorithm.local_steps_per_round = K
+                try:
+                    return self.round_fn(server, clients, data, val_data)
+                finally:
+                    self.local_steps = old
+                    self.algorithm.local_steps_per_round = old_alg
+            self._round_cache[K] = jax.jit(fn, donate_argnums=(0, 1))
+        return self._round_cache[K]
+
+    def _reshuffle(self, epoch_seed: int):
+        """reshuffle_per_epoch: re-partition across workers
+        (distributed.py:129-134 -> consistent shuffled indices)."""
+        feats, labels = self._raw_splits
+        parts = iid_partition(len(labels), self.num_clients,
+                              seed=epoch_seed)
+        self.data = shard_clients(
+            stack_partitions(feats, labels, parts), self.mesh)
+
+    def fit(self, rng: jax.Array, callback=None):
+        """Run until the stop criterion (distributed.py:107-120):
+        epoch count or iteration count."""
+        server, clients = self.init_state(rng)
+        cfg = self.cfg
+        num_epochs = cfg.train.num_epochs or 1
+        history = []
+        last_epoch_int = 0
+        while True:
+            epoch = float(jnp.mean(clients.epoch))
+            it = int(jnp.max(clients.local_index))
+            if cfg.train.stop_criteria == "iteration" \
+                    and cfg.train.num_iterations is not None:
+                if it >= cfg.train.num_iterations:
+                    break
+            elif epoch >= num_epochs:
+                break
+            epoch_idx = min(int(epoch), len(self.steps_schedule) - 1)
+            if cfg.data.reshuffle_per_epoch \
+                    and self._raw_splits is not None \
+                    and int(epoch) > last_epoch_int:
+                last_epoch_int = int(epoch)
+                self._reshuffle(cfg.train.manual_seed + last_epoch_int)
+            K = max(self.steps_schedule[epoch_idx], 1)
+            server, clients, metrics = self._round_with_steps(K)(
+                server, clients, self.data, self.val_data)
+            if callback is not None:
+                callback(server, clients, metrics)
+            history.append(metrics)
+        return server, clients, history
+
+
+def build_local_sgd(cfg: ExperimentConfig, model: ModelDef,
+                    features: np.ndarray, labels: np.ndarray,
+                    mesh=None) -> LocalSGDTrainer:
+    """Partition a dataset IID across workers and build the trainer
+    (the define_dataset path of the non-federated mode)."""
+    parts = iid_partition(len(labels), cfg.federated.num_clients,
+                          seed=cfg.train.manual_seed)
+    data = stack_partitions(features, labels, parts)
+    return LocalSGDTrainer(cfg, model, data, mesh=mesh,
+                           raw_splits=(features, labels))

@@ -1,0 +1,135 @@
+"""AFL and DRFA minimax algorithms."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from fedtorch_tpu.algorithms import make_algorithm
+from fedtorch_tpu.algorithms.drfa import DRFA
+from fedtorch_tpu.config import (
+    DataConfig, ExperimentConfig, FederatedConfig, ModelConfig, OptimConfig,
+    TrainConfig,
+)
+from fedtorch_tpu.data import build_federated_data
+from fedtorch_tpu.models import define_model
+from fedtorch_tpu.parallel import FederatedTrainer, evaluate
+
+
+def _trainer(algorithm, lr=0.3, local_step=5, num_clients=8, rate=0.5,
+             drfa=False, **fed_kw):
+    cfg = ExperimentConfig(
+        data=DataConfig(dataset="synthetic", synthetic_dim=20,
+                        batch_size=16, synthetic_alpha=1.0,
+                        synthetic_beta=1.0),
+        federated=FederatedConfig(federated=True, num_clients=num_clients,
+                                  online_client_rate=rate,
+                                  algorithm=algorithm, drfa=drfa,
+                                  sync_type="local_step", **fed_kw),
+        model=ModelConfig(arch="logistic_regression"),
+        optim=OptimConfig(lr=lr, weight_decay=0.0),
+        train=TrainConfig(local_step=local_step),
+    ).finalize()
+    data = build_federated_data(cfg)
+    model = define_model(cfg, batch_size=16)
+    trainer = FederatedTrainer(cfg, model, make_algorithm(cfg), data.train)
+    return trainer, data
+
+
+def _run(trainer, rounds, seed=0):
+    server, clients = trainer.init_state(jax.random.key(seed))
+    for _ in range(rounds):
+        server, clients, metrics = trainer.run_round(server, clients)
+    return server, clients, metrics
+
+
+class TestAFL:
+    def test_config_coercion(self):
+        trainer, _ = _trainer("afl")
+        # afl forces local_step=1 + sync local_step (parameters.py:249-251)
+        assert trainer.cfg.train.local_step == 1
+        assert trainer.cfg.federated.sync_type == "local_step"
+        assert trainer.local_steps == 1
+
+    def test_lambda_on_simplex_after_rounds(self):
+        trainer, _ = _trainer("afl", drfa_gamma=0.5)
+        server, _, _ = _run(trainer, 5)
+        lam = np.asarray(server.aux["lambda"])
+        assert lam.sum() == pytest.approx(1.0, abs=1e-5)
+        assert lam.min() > 0
+
+    def test_lambda_concentrates_on_lossy_client(self):
+        """The dual ascends toward high-loss clients."""
+        trainer, _ = _trainer("afl", drfa_gamma=1.0, rate=1.0)
+        server, clients, _ = _run(trainer, 8)
+        lam = np.asarray(server.aux["lambda"])
+        assert lam.std() > 1e-4  # moved away from uniform
+
+    def test_converges(self):
+        trainer, data = _trainer("afl", lr=0.3, rate=1.0,
+                                 drfa_gamma=0.1)
+        server, _, _ = _run(trainer, 25)
+        res = evaluate(trainer.model, server.params, data.test_x,
+                       data.test_y, batch_size=128)
+        assert float(res.top1) > 0.45
+
+
+class TestDRFA:
+    @pytest.mark.parametrize("inner", ["fedavg", "fedgate", "scaffold"])
+    def test_wraps_inner(self, inner):
+        trainer, _ = _trainer(inner, drfa=True)
+        assert isinstance(trainer.algorithm, DRFA)
+        assert trainer.algorithm.inner.name == inner
+
+    def test_rejects_bad_inner(self):
+        with pytest.raises(ValueError, match="DRFA wraps"):
+            _trainer("qffl", drfa=True)
+
+    def test_lambda_init_proportional_to_sizes(self):
+        trainer, data = _trainer("fedavg", drfa=True)
+        server, clients = trainer.init_state(jax.random.key(0))
+        lam = np.asarray(server.aux["lambda"])
+        sizes = np.asarray(trainer.data.sizes, np.float32)
+        np.testing.assert_allclose(lam, sizes / sizes.sum(), rtol=1e-5)
+
+    def test_round_runs_and_lambda_updates(self):
+        trainer, _ = _trainer("fedavg", drfa=True, drfa_gamma=0.5)
+        server, clients = trainer.init_state(jax.random.key(1))
+        lam0 = np.asarray(server.aux["lambda"])
+        server, clients, metrics = trainer.run_round(server, clients)
+        lam1 = np.asarray(server.aux["lambda"])
+        assert not np.allclose(lam0, lam1)
+        assert lam1.sum() == pytest.approx(1.0, abs=1e-5)
+        # kth_avg snapshot is populated (non-zero)
+        kth_norm = sum(float(jnp.abs(x).sum())
+                       for x in jax.tree.leaves(server.aux["kth_avg"]))
+        assert kth_norm > 0
+
+    def test_lambda_weighted_sampling(self):
+        """Clients with larger lambda are sampled more often."""
+        trainer, _ = _trainer("fedavg", drfa=True, num_clients=8, rate=0.25)
+        alg = trainer.algorithm
+        lam = jnp.asarray([0.6, 0.2, 0.05, 0.05, 0.025, 0.025, 0.025,
+                           0.025])
+        counts = np.zeros(8)
+        for s in range(300):
+            idx = alg.participation(jax.random.key(s), 8, 2,
+                                    jnp.asarray(1), {"lambda": lam})
+            counts[np.asarray(idx)] += 1
+        assert counts[0] > counts[2] > 0 or counts[0] > 50
+        assert counts[0] == max(counts)
+
+    def test_converges(self):
+        trainer, data = _trainer("fedavg", drfa=True, lr=0.3,
+                                 drfa_gamma=0.05, local_step=5)
+        server, _, _ = _run(trainer, 20)
+        res = evaluate(trainer.model, server.params, data.test_x,
+                       data.test_y, batch_size=128)
+        assert float(res.top1) > 0.45
+
+    def test_drfa_scaffold_converges(self):
+        trainer, data = _trainer("scaffold", drfa=True, lr=0.3,
+                                 drfa_gamma=0.05, local_step=5)
+        server, _, _ = _run(trainer, 15)
+        res = evaluate(trainer.model, server.params, data.test_x,
+                       data.test_y, batch_size=128)
+        assert float(res.top1) > 0.4
