@@ -6,9 +6,14 @@ SURVEY.md §3.5), a minimax wrapper around an inner aggregation algorithm
 
 * lambda [C] initialized proportional to client sample sizes
   (drfa.py:51-57);
-* online clients sampled FROM the lambda distribution without replacement
-  (misc.py:30-37) — here via Gumbel top-k, which is the same
-  sequential-renormalization scheme numpy uses;
+* the dual step size decays 0.9x every round (drfa.py:77);
+* client sampling is UNIFORM in both phases (drfa.py:71,216 use
+  set_online_clients; the lambda-weighted sampler misc.py:30-37 exists in
+  the reference but is never called by its DRFA loop). Set
+  ``FederatedConfig.drfa_lambda_sampling=True`` for the paper-faithful
+  lambda-distributed sampling via Gumbel top-k (the same
+  sequential-renormalization scheme numpy's choice(p=..,replace=False)
+  uses);
 * aggregation weights: ``lambda_i * C / num_online`` (fedavg.py:27's
   lambda_weight branch), applied through the inner algorithm's payload;
 * a shared random step index k ~ U[1, K) is broadcast each round
@@ -61,12 +66,17 @@ class DRFA(FedAlgorithm):
         lam = self._sizes / jnp.sum(self._sizes)  # drfa.py:51-57
         return {"inner": self.inner.init_server_aux(params, num_clients),
                 "lambda": lam,
+                "gamma": jnp.asarray(self.cfg.federated.drfa_gamma),
                 "kth_avg": tree_zeros_like(params)}
 
     # -- sampling & weighting ---------------------------------------------
     def participation(self, rng, num_clients, k, round_idx, server_aux):
-        # Gumbel top-k == sampling w/o replacement from lambda
-        # (misc.py:30-37 np.random.choice p=lambda)
+        if not self.cfg.federated.drfa_lambda_sampling:
+            # reference behavior: engine's default uniform sampling with
+            # round-0 client-0 forcing (drfa.py:71-75)
+            return None
+        # paper-faithful option: Gumbel top-k == sampling w/o replacement
+        # from lambda (the reference's unused misc.py:30-37 sampler)
         lam = jnp.clip(server_aux["lambda"], 1e-12, None)
         g = jax.random.gumbel(rng, (num_clients,))
         return jax.lax.top_k(jnp.log(lam) + g, k)[1]
@@ -87,11 +97,6 @@ class DRFA(FedAlgorithm):
                 aux=server.aux["inner"]),
             x=x, y=y, sizes=sizes, lr=lr, rng=rng)
         return dict(on_aux, inner=inner_aux, k_rand=k_full)
-
-    def transform_grads(self, grads, **kw):
-        kw["server_aux"] = kw["server_aux"]["inner"]
-        kw["client_aux"] = kw["client_aux"]["inner"]
-        return self.inner.transform_grads(grads, **kw)
 
     def local_step(self, *, params, opt, client_aux, rnn_carry,
                    server_params, server_aux, bx, by, bval_x, bval_y, lr,
@@ -164,10 +169,12 @@ class DRFA(FedAlgorithm):
         losses = jax.vmap(one_loss)(idx2, jax.random.split(rng_batch, k))
         num_online2 = num_online_effective(idx2)
         lam = server.aux["lambda"]
+        # per-round decayed dual step size (drfa.py:77 gamma *= 0.9)
+        gamma = server.aux["gamma"] * 0.9
         # loss_tensor scaled by n/num_online (drfa.py:239-241)
         loss_vec = jnp.zeros_like(lam).at[idx2].set(
             losses * C / num_online2)
-        lam = lam + self.cfg.federated.drfa_gamma \
-            * self.local_steps_per_round * loss_vec
+        lam = lam + gamma * self.local_steps_per_round * loss_vec
         lam = project_simplex_floor(lam, floor=1e-3)
-        return server._replace(aux=dict(server.aux, **{"lambda": lam}))
+        return server._replace(
+            aux=dict(server.aux, **{"lambda": lam, "gamma": gamma}))

@@ -1,0 +1,379 @@
+"""Command-line entry point.
+
+Parity with the reference's flag system + entry points (parameters.py
+get_args ~90 flags; main.py / main_centered.py): one argparse surface
+mapping onto the typed :class:`ExperimentConfig`, a ``--backend`` switch
+replacing ``mpirun`` process launch (tpu = all visible TPU devices over
+one mesh; cpu = virtual host mesh for debugging, the centered-mode
+analog), and the train/validate/checkpoint driver loop
+(federated/main.py:56-211).
+
+Usage:
+    python -m fedtorch_tpu.cli --federated true --data synthetic \
+        --federated_type fedavg --num_comms 20 --num_clients 10
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from typing import Optional
+
+from fedtorch_tpu.config import (
+    CheckpointConfig, DataConfig, ExperimentConfig, FederatedConfig,
+    LRConfig, MeshConfig, ModelConfig, OptimConfig, TrainConfig,
+)
+
+
+def str2bool(v) -> bool:
+    """parameters.py:263-280."""
+    if isinstance(v, bool):
+        return v
+    if v.lower() in ("yes", "true", "t", "y", "1"):
+        return True
+    if v.lower() in ("no", "false", "f", "n", "0"):
+        return False
+    raise argparse.ArgumentTypeError(f"Boolean value expected, got {v!r}")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="fedtorch_tpu: TPU-native federated learning")
+    # dataset (parameters.py:23-37)
+    p.add_argument("-d", "--data", default="cifar10")
+    p.add_argument("-p", "--data_dir", default="./data/")
+    p.add_argument("--download", type=str2bool, default=False)
+    p.add_argument("--partition_data", type=str2bool, default=True)
+    p.add_argument("--synthetic_alpha", type=float, default=0.0)
+    p.add_argument("--synthetic_beta", type=float, default=0.0)
+    p.add_argument("--sensitive_feature", type=int, default=9)
+    # federated (parameters.py:40-110)
+    p.add_argument("-f", "--federated", type=str2bool, default=False)
+    p.add_argument("--num_class_per_client", type=int, default=1)
+    p.add_argument("--num_comms", type=int, default=100)
+    p.add_argument("--online_client_rate", type=float, default=0.1)
+    p.add_argument("--federated_sync_type", default="epoch",
+                   choices=["epoch", "local_step"])
+    p.add_argument("--num_epochs_per_comm", type=int, default=1)
+    p.add_argument("--iid_data", type=str2bool, default=True)
+    p.add_argument("--federated_type", default="fedavg")
+    p.add_argument("--unbalanced", type=str2bool, default=False)
+    p.add_argument("--dirichlet", type=str2bool, default=False)
+    p.add_argument("--fed_personal", type=str2bool, default=False)
+    p.add_argument("--fed_personal_alpha", type=float, default=0.5)
+    p.add_argument("--fed_adaptive_alpha", type=str2bool, default=False)
+    p.add_argument("--fed_personal_test", type=str2bool, default=False)
+    p.add_argument("--fedadam_beta", type=float, default=0.9)
+    p.add_argument("--fedadam_tau", type=float, default=0.1)
+    p.add_argument("--quantized", type=str2bool, default=False)
+    p.add_argument("--quantized_bits", type=int, default=8)
+    p.add_argument("--compressed", type=str2bool, default=False)
+    p.add_argument("--compressed_ratio", type=float, default=1.0)
+    p.add_argument("--federated_drfa", type=str2bool, default=False)
+    p.add_argument("--drfa_gamma", type=float, default=0.1)
+    p.add_argument("--perfedavg_beta", type=float, default=0.001)
+    p.add_argument("--fedprox_mu", type=float, default=0.002)
+    p.add_argument("--perfedme_lambda", type=float, default=15.0)
+    p.add_argument("--qffl_q", type=float, default=0.0)
+    # model (parameters.py:113-115, 180-194)
+    p.add_argument("-a", "--arch", default="mlp")
+    p.add_argument("--norm", default="bn", choices=["bn", "gn"])
+    p.add_argument("--drop_rate", type=float, default=0.0)
+    p.add_argument("--densenet_growth_rate", type=int, default=12)
+    p.add_argument("--densenet_bc_mode", type=str2bool, default=False)
+    p.add_argument("--densenet_compression", type=float, default=0.5)
+    p.add_argument("--wideresnet_widen_factor", type=int, default=4)
+    p.add_argument("--mlp_num_layers", type=int, default=2)
+    p.add_argument("--mlp_hidden_size", type=int, default=500)
+    p.add_argument("--rnn_seq_len", type=int, default=50)
+    p.add_argument("--rnn_hidden_size", type=int, default=50)
+    p.add_argument("--vocab_size", type=int, default=86)
+    # training scheme (parameters.py:118-141)
+    p.add_argument("--stop_criteria", default="epoch")
+    p.add_argument("--num_epochs", type=int, default=None)
+    p.add_argument("--num_iterations", type=int, default=None)
+    p.add_argument("--local_step", type=int, default=1)
+    p.add_argument("--local_step_warmup_type", default=None)
+    p.add_argument("--local_step_warmup_period", type=int, default=None)
+    p.add_argument("--local_step_warmup_per_interval", type=str2bool,
+                   default=False)
+    p.add_argument("--turn_on_local_step_from", type=int, default=None)
+    p.add_argument("--turn_off_local_step_from", type=int, default=None)
+    p.add_argument("--avg_model", type=str2bool, default=True)
+    p.add_argument("--reshuffle_per_epoch", type=str2bool, default=False)
+    p.add_argument("-b", "--batch_size", type=int, default=50)
+    p.add_argument("--growing_batch_size", type=str2bool, default=False)
+    p.add_argument("--base_batch_size", type=int, default=None)
+    p.add_argument("--max_batch_size", type=int, default=0)
+    # learning rate (parameters.py:144-166)
+    p.add_argument("--lr", type=float, default=0.01)
+    p.add_argument("--lr_schedule_scheme", default=None)
+    p.add_argument("--lr_change_epochs", default=None)
+    p.add_argument("--lr_fields", default=None)
+    p.add_argument("--lr_scale_indicators", default=None)
+    p.add_argument("--lr_scaleup", type=str2bool, default=False)
+    p.add_argument("--lr_scaleup_type", default="linear")
+    p.add_argument("--lr_scale_at_sync", type=float, default=1.0)
+    p.add_argument("--lr_warmup", type=str2bool, default=False)
+    p.add_argument("--lr_warmup_epochs", type=int, default=5)
+    p.add_argument("--lr_decay", type=float, default=10.0)
+    p.add_argument("--lr_onecycle_low", type=float, default=0.15)
+    p.add_argument("--lr_onecycle_high", type=float, default=3.0)
+    p.add_argument("--lr_onecycle_extra_low", type=float, default=0.0015)
+    p.add_argument("--lr_onecycle_num_epoch", type=int, default=46)
+    p.add_argument("--lr_gamma", type=float, default=None)
+    p.add_argument("--lr_mu", type=float, default=None)
+    p.add_argument("--lr_alpha", type=float, default=None)
+    # optimizer (parameters.py:168-183)
+    p.add_argument("--optimizer", default="sgd")
+    p.add_argument("--in_momentum", type=str2bool, default=False)
+    p.add_argument("--in_momentum_factor", type=float, default=0.9)
+    p.add_argument("--out_momentum", type=str2bool, default=False)
+    p.add_argument("--out_momentum_factor", type=float, default=None)
+    p.add_argument("--use_nesterov", type=str2bool, default=False)
+    p.add_argument("--weight_decay", type=float, default=5e-4)
+    p.add_argument("--correct_wd", type=str2bool, default=False)
+    # misc / checkpoint (parameters.py:196-222)
+    p.add_argument("--manual_seed", type=int, default=6)
+    p.add_argument("--evaluate", "-e", type=str2bool, default=False)
+    p.add_argument("--eval_freq", type=int, default=1)
+    p.add_argument("--summary_freq", type=int, default=10)
+    p.add_argument("--debug", type=str2bool, default=True)
+    p.add_argument("--resume", default=None)
+    p.add_argument("--checkpoint_index", default=None)
+    p.add_argument("-c", "--checkpoint", default="./checkpoint/")
+    p.add_argument("--save_all_models", type=str2bool, default=False)
+    p.add_argument("--save_some_models", default="1,29,59")
+    p.add_argument("--log_dir", default="./logdir/")
+    p.add_argument("--experiment", default=None)
+    # device / mesh (replaces parameters.py:225-236 MPI block)
+    p.add_argument("--backend", default=None,
+                   help="jax platform: tpu|cpu|None(auto)")
+    p.add_argument("--num_devices", type=int, default=None)
+    p.add_argument("--num_workers", "-j", "--world_size", type=int,
+                   default=10, dest="num_workers",
+                   help="number of clients/workers (MPI world size)")
+    p.add_argument("--coordinator_address", default=None,
+                   help="multi-host DCN coordinator (host:port)")
+    p.add_argument("--num_processes", type=int, default=None)
+    p.add_argument("--process_id", type=int, default=None)
+    return p
+
+
+def args_to_config(args) -> ExperimentConfig:
+    cfg = ExperimentConfig(
+        data=DataConfig(
+            dataset=args.data, data_dir=args.data_dir,
+            partition_data=args.partition_data, iid=args.iid_data,
+            num_class_per_client=args.num_class_per_client,
+            unbalanced=args.unbalanced, dirichlet=args.dirichlet,
+            synthetic_alpha=args.synthetic_alpha,
+            synthetic_beta=args.synthetic_beta,
+            sensitive_feature=args.sensitive_feature,
+            batch_size=args.batch_size,
+            growing_batch_size=args.growing_batch_size,
+            base_batch_size=args.base_batch_size,
+            max_batch_size=args.max_batch_size,
+            reshuffle_per_epoch=args.reshuffle_per_epoch),
+        federated=FederatedConfig(
+            federated=args.federated, num_clients=args.num_workers,
+            num_comms=args.num_comms,
+            online_client_rate=args.online_client_rate,
+            sync_type=args.federated_sync_type,
+            num_epochs_per_comm=args.num_epochs_per_comm,
+            algorithm=args.federated_type, personal=args.fed_personal,
+            personal_alpha=args.fed_personal_alpha,
+            adaptive_alpha=args.fed_adaptive_alpha,
+            personal_test=args.fed_personal_test,
+            fedadam_beta=args.fedadam_beta, fedadam_tau=args.fedadam_tau,
+            quantized=args.quantized, quantized_bits=args.quantized_bits,
+            compressed=args.compressed,
+            compressed_ratio=args.compressed_ratio,
+            drfa=args.federated_drfa, drfa_gamma=args.drfa_gamma,
+            perfedavg_beta=args.perfedavg_beta,
+            fedprox_mu=args.fedprox_mu,
+            perfedme_lambda=args.perfedme_lambda, qffl_q=args.qffl_q),
+        model=ModelConfig(
+            arch=args.arch, norm=args.norm, drop_rate=args.drop_rate,
+            densenet_growth_rate=args.densenet_growth_rate,
+            densenet_bc_mode=args.densenet_bc_mode,
+            densenet_compression=args.densenet_compression,
+            wideresnet_widen_factor=args.wideresnet_widen_factor,
+            mlp_num_layers=args.mlp_num_layers,
+            mlp_hidden_size=args.mlp_hidden_size,
+            rnn_seq_len=args.rnn_seq_len,
+            rnn_hidden_size=args.rnn_hidden_size,
+            vocab_size=args.vocab_size),
+        optim=OptimConfig(
+            optimizer=args.optimizer, lr=args.lr,
+            in_momentum=args.in_momentum,
+            in_momentum_factor=args.in_momentum_factor,
+            out_momentum=args.out_momentum,
+            out_momentum_factor=args.out_momentum_factor,
+            use_nesterov=args.use_nesterov,
+            weight_decay=args.weight_decay, correct_wd=args.correct_wd,
+            lr_scale_at_sync=args.lr_scale_at_sync),
+        lr_schedule=LRConfig(
+            schedule_scheme=args.lr_schedule_scheme,
+            lr_change_epochs=args.lr_change_epochs,
+            lr_fields=args.lr_fields,
+            lr_scale_indicators=args.lr_scale_indicators,
+            scaleup=args.lr_scaleup, scaleup_type=args.lr_scaleup_type,
+            warmup=args.lr_warmup, warmup_epochs=args.lr_warmup_epochs,
+            decay=args.lr_decay, onecycle_low=args.lr_onecycle_low,
+            onecycle_high=args.lr_onecycle_high,
+            onecycle_extra_low=args.lr_onecycle_extra_low,
+            onecycle_num_epoch=args.lr_onecycle_num_epoch,
+            gamma=args.lr_gamma, mu=args.lr_mu, alpha=args.lr_alpha),
+        train=TrainConfig(
+            stop_criteria=args.stop_criteria, num_epochs=args.num_epochs,
+            num_iterations=args.num_iterations,
+            local_step=args.local_step,
+            local_step_warmup_type=args.local_step_warmup_type,
+            local_step_warmup_period=args.local_step_warmup_period,
+            local_step_warmup_per_interval=(
+                args.local_step_warmup_per_interval),
+            turn_on_local_step_from=args.turn_on_local_step_from,
+            turn_off_local_step_from=args.turn_off_local_step_from,
+            avg_model=args.avg_model, manual_seed=args.manual_seed,
+            evaluate=args.evaluate, eval_freq=args.eval_freq,
+            summary_freq=args.summary_freq),
+        checkpoint=CheckpointConfig(
+            checkpoint_dir=args.checkpoint, resume=args.resume,
+            checkpoint_index=args.checkpoint_index,
+            save_all_models=args.save_all_models,
+            save_some_models=args.save_some_models,
+            log_dir=args.log_dir, debug=args.debug),
+        mesh=MeshConfig(
+            backend=args.backend, num_devices=args.num_devices,
+            coordinator_address=args.coordinator_address,
+            num_processes=args.num_processes, process_id=args.process_id),
+        experiment=args.experiment,
+    )
+    return cfg.finalize()
+
+
+def run_experiment(cfg: ExperimentConfig,
+                   download: bool = False) -> dict:
+    """The driver loop (main.py dispatch + federated/main.py:56-211)."""
+    import jax
+    import jax.numpy as jnp
+
+    from fedtorch_tpu.algorithms import make_algorithm
+    from fedtorch_tpu.core.schedule import compile_schedule, lr_at
+    from fedtorch_tpu.data import build_federated_data
+    from fedtorch_tpu.models import define_model
+    from fedtorch_tpu.parallel import (
+        FederatedTrainer, build_local_sgd, evaluate, evaluate_personal,
+        init_multihost,
+    )
+    from fedtorch_tpu.utils import (
+        PhaseTimer, RunLogger, init_checkpoint_dir, maybe_resume,
+        save_checkpoint,
+    )
+
+    if cfg.mesh.backend == "cpu" \
+            and os.environ.get("JAX_PLATFORMS") != "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    init_multihost(cfg.mesh)
+
+    ckpt_dir = init_checkpoint_dir(cfg)
+    logger = RunLogger(ckpt_dir, debug=cfg.checkpoint.debug)
+    logger.log_args(cfg)
+    logger.log(f"devices: {jax.devices()}")
+    timer = PhaseTimer()
+
+    timer.start("data")
+    fed_data = build_federated_data(cfg, download=download)
+    model = define_model(cfg, batch_size=cfg.data.batch_size)
+    timer.stop("data")
+
+    rng = jax.random.key(cfg.train.manual_seed)
+
+    if not cfg.federated.federated:
+        # local-SGD mode: flatten the per-worker shards back into one
+        # training set and IID-repartition across workers
+        import numpy as np
+        splits_x = np.asarray(fed_data.train.x).reshape(
+            (-1,) + fed_data.train.x.shape[2:])
+        splits_y = np.asarray(fed_data.train.y).reshape(-1)
+        trainer = build_local_sgd(cfg, model, splits_x, splits_y)
+        server, clients, history = trainer.fit(rng)
+        res = evaluate(model, server.params, fed_data.test_x,
+                       fed_data.test_y)
+        logger.log_val(len(history), "test", float(res.loss),
+                       float(res.top1), float(res.top5))
+        return {"test_top1": float(res.top1), "rounds": len(history)}
+
+    algorithm = make_algorithm(cfg)
+    trainer = FederatedTrainer(cfg, model, algorithm, fed_data.train,
+                               val_data=fed_data.val)
+    server, clients = trainer.init_state(rng)
+    server, clients, best_prec1, resumed = maybe_resume(
+        cfg.checkpoint.resume, server, clients, cfg,
+        cfg.checkpoint.checkpoint_index)
+    if resumed:
+        logger.log(f"resumed from round {int(server.round)}")
+
+    schedule = trainer.schedule
+    save_rounds = tuple(
+        int(x) for x in cfg.checkpoint.save_some_models.split(","))
+    results = {}
+    start_round = int(server.round)
+    for r in range(start_round, cfg.federated.num_comms):
+        timer.new_round()
+        timer.start("round")
+        server, clients, metrics = trainer.run_round(server, clients)
+        jax.block_until_ready(server.params)
+        round_time = timer.stop("round")
+        timer.add_comm(num_bytes=float(metrics.comm_bytes))
+
+        n_online = float(jnp.sum(metrics.online_mask))
+        loss = float(jnp.sum(metrics.train_loss) / max(n_online, 1))
+        acc = float(jnp.sum(metrics.train_acc) / max(n_online, 1))
+        epoch = float(jnp.mean(clients.epoch))
+        logger.log_train(r, epoch, loss, acc,
+                         float(lr_at(schedule, epoch)),
+                         comm_bytes=float(metrics.comm_bytes),
+                         round_time=round_time)
+
+        if (r + 1) % cfg.train.eval_freq == 0:
+            timer.start("eval")
+            res = evaluate(model, server.params, fed_data.test_x,
+                           fed_data.test_y)
+            timer.stop("eval")
+            top1 = float(res.top1)
+            is_best = top1 > best_prec1
+            best_prec1 = max(best_prec1, top1)
+            logger.log_val(r, "test", float(res.loss), top1,
+                           float(res.top5), best=best_prec1)
+            timer.start("checkpoint")
+            save_checkpoint(ckpt_dir, server, clients, cfg, best_prec1,
+                            is_best,
+                            save_all=cfg.checkpoint.save_all_models,
+                            save_some_rounds=save_rounds)
+            timer.stop("checkpoint")
+            if cfg.federated.personal and fed_data.val is not None \
+                    and cfg.effective_algorithm in (
+                        "apfl", "perfedme", "perfedavg"):
+                _, _, summary = evaluate_personal(
+                    model, clients.aux, clients.params, trainer.val_data,
+                    cfg.effective_algorithm)
+                logger.log_val(r, "validation_personal",
+                               summary["loss_mean"], summary["acc_mean"])
+            results["test_top1"] = top1
+    results["best_top1"] = best_prec1
+    results["timer"] = timer.summary()
+    logger.log(f"phase timers: {timer.summary()}")
+    return results
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    cfg = args_to_config(args)
+    return run_experiment(cfg, download=args.download)
+
+
+if __name__ == "__main__":
+    main()

@@ -93,6 +93,9 @@ class FederatedConfig:
     # DRFA wrapper (ref: parameters.py:90-97).
     drfa: bool = False
     drfa_gamma: float = 0.1
+    # paper-faithful lambda-distributed client sampling; the reference's
+    # loop samples uniformly (drfa.py:71,216) despite misc.py:30-37
+    drfa_lambda_sampling: bool = False
     # Per-algorithm scalars.
     perfedavg_beta: float = 0.001
     fedprox_mu: float = 0.002

@@ -1,0 +1,3 @@
+from fedtorch_tpu.tools.records import (  # noqa: F401
+    load_record_file, parse_records, smoothing,
+)

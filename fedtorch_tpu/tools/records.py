@@ -1,0 +1,92 @@
+"""Post-hoc log parsing & summarization.
+
+Parity with ``fedtorch/tools/``: regex-parse record files back into
+structured tables (load_console_records.py:13-25), aggregate runs under a
+checkpoint root with condition filtering (get_summary.py:100-158), and
+smoothing for plots (plot_utils.py:10-60). Tables are plain dicts of numpy
+arrays (pandas-compatible via ``pd.DataFrame(table)``).
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Optional
+
+import numpy as np
+
+# matches RunLogger.log_train lines
+_TRAIN_RE = re.compile(
+    r"Round: (?P<round>\d+)\. Epoch: (?P<epoch>[\d.]+)\. "
+    r"Local index: \d+\. Load: (?P<load>[\d.]+)s \| "
+    r"Computing: (?P<computing>[\d.]+)s \| Sync: (?P<sync>[\d.]+)s \| "
+    r"Global: (?P<global>[\d.]+)s \| Loss: (?P<loss>[-\d.einf]+) \| "
+    r"top1: (?P<top1>[\d.]+) \| lr: (?P<lr>[\d.e-]+) \| "
+    r"CommBytes: (?P<comm_bytes>[\d.]+)")
+
+# matches RunLogger.log_val lines
+_VAL_RE = re.compile(
+    r"Round: (?P<round>\d+)\. Mode: (?P<mode>\w+)\. "
+    r"Loss: (?P<loss>[-\d.einf]+) \| top1: (?P<top1>[\d.]+) \| "
+    r"top5: (?P<top5>[\d.]+)")
+
+_COMM_RE = re.compile(
+    r"This round communication time is: (?P<seconds>[\d.e-]+)")
+
+
+def load_record_file(path: str) -> Dict[str, List[dict]]:
+    """Parse one record file into train/val/comm row lists
+    (load_console_records.py:13-25 equivalent for our formats)."""
+    out = {"train": [], "val": [], "comm": []}
+    with open(path) as f:
+        for line in f:
+            m = _TRAIN_RE.search(line)
+            if m:
+                out["train"].append(
+                    {k: float(v) for k, v in m.groupdict().items()})
+                continue
+            m = _VAL_RE.search(line)
+            if m:
+                row = m.groupdict()
+                out["val"].append({
+                    "round": float(row["round"]), "mode": row["mode"],
+                    "loss": float(row["loss"]),
+                    "top1": float(row["top1"]),
+                    "top5": float(row["top5"])})
+                continue
+            m = _COMM_RE.search(line)
+            if m:
+                out["comm"].append({"seconds": float(m.group("seconds"))})
+    return out
+
+
+def parse_records(checkpoint_root: str,
+                  conditions: Optional[Dict[str, str]] = None
+                  ) -> List[dict]:
+    """Walk a checkpoint tree, parse every record file, and filter by
+    substring conditions on the run-folder name (get_summary.py:100-158).
+
+    Returns a list of {"path", "name", "records"} entries."""
+    runs = []
+    for dirpath, _, files in os.walk(checkpoint_root):
+        for fname in files:
+            if not fname.startswith("record"):
+                continue
+            name = os.path.basename(dirpath)
+            if conditions and not all(
+                    f"{k}-{v}" in name for k, v in conditions.items()):
+                continue
+            runs.append({
+                "path": dirpath,
+                "name": name,
+                "records": load_record_file(os.path.join(dirpath, fname)),
+            })
+    return runs
+
+
+def smoothing(values, window: int = 10) -> np.ndarray:
+    """Moving-average smoothing for plotting (plot_utils.py:10-60)."""
+    values = np.asarray(values, np.float64)
+    if len(values) == 0 or window <= 1:
+        return values
+    kernel = np.ones(min(window, len(values))) / min(window, len(values))
+    return np.convolve(values, kernel, mode="valid")

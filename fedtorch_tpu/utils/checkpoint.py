@@ -1,0 +1,143 @@
+"""Checkpoint / resume.
+
+Parity with ``logs/checkpoint.py`` — and one deliberate upgrade: the
+reference checkpoints only the server's aggregated model (:68-82), losing
+client aux state (control variates, error-feedback memory, personal
+models, dual variables) on resume (SURVEY.md §5.4). Here the FULL round
+state pytree — ServerState + ClientState, including the threaded PRNG key
+and round counter — is serialized, so a resumed run continues exactly.
+
+* run-folder naming from hyperparams + timestamp
+  (get_checkpoint_folder_name, checkpoint.py:12-45);
+* best-accuracy copy (``model_best``) and optional per-round keeps
+  (save_some_models, checkpoint.py:68-82);
+* resume with config-compatibility validation (same dataset/batch size,
+  new num_epochs >= old — checkpoint.py:93-139).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+import time
+from typing import Optional, Tuple
+
+import jax
+from flax import serialization
+
+from fedtorch_tpu.config import ExperimentConfig
+
+
+def get_checkpoint_folder_name(cfg: ExperimentConfig) -> str:
+    """Hyperparam-encoding run directory name (checkpoint.py:12-45)."""
+    fed = cfg.federated
+    parts = [
+        time.strftime("%Y-%m-%d_%H-%M-%S"),
+        f"l2-{cfg.optim.weight_decay}",
+        f"lr-{cfg.optim.lr}",
+        f"momentum-{cfg.optim.in_momentum_factor}",
+        f"batchsize-{cfg.data.batch_size}",
+        f"arch-{cfg.model.arch}",
+        f"data-{cfg.data.dataset}",
+    ]
+    if fed.federated:
+        parts += [f"alg-{cfg.effective_algorithm}",
+                  f"clients-{fed.num_clients}",
+                  f"rate-{fed.online_client_rate}"]
+    return "_".join(parts)
+
+
+def init_checkpoint_dir(cfg: ExperimentConfig) -> str:
+    root = os.path.join(cfg.checkpoint.checkpoint_dir, cfg.data.dataset,
+                        cfg.model.arch, get_checkpoint_folder_name(cfg))
+    os.makedirs(root, exist_ok=True)
+    return root
+
+
+def _compat_meta(cfg: ExperimentConfig) -> dict:
+    return {
+        "dataset": cfg.data.dataset,
+        "batch_size": cfg.data.batch_size,
+        "arch": cfg.model.arch,
+        "num_epochs": cfg.train.num_epochs,
+        "algorithm": cfg.effective_algorithm,
+        "num_clients": cfg.federated.num_clients,
+    }
+
+
+def _unkey(server):
+    """Typed PRNG keys are not serializable; carry the raw key data."""
+    return server._replace(rng=jax.random.key_data(server.rng))
+
+
+def _rekey(server):
+    return server._replace(rng=jax.random.wrap_key_data(server.rng))
+
+
+def save_checkpoint(directory: str, server, clients,
+                    cfg: ExperimentConfig, best_prec1: float,
+                    is_best: bool, save_all: bool = False,
+                    save_some_rounds: Tuple[int, ...] = ()) -> str:
+    """Serialize the full round state (checkpoint.py:68-82 semantics)."""
+    os.makedirs(directory, exist_ok=True)
+    payload = serialization.to_bytes(
+        {"server": _unkey(server), "clients": clients})
+    round_idx = int(server.round)
+    path = os.path.join(directory, "checkpoint.ckpt")
+    with open(path, "wb") as f:
+        f.write(payload)
+    meta = {
+        "arguments": _compat_meta(cfg),
+        "round": round_idx,
+        "best_prec1": best_prec1,
+        "config": dataclasses.asdict(cfg),
+    }
+    with open(os.path.join(directory, "checkpoint.json"), "w") as f:
+        json.dump(meta, f, default=str)
+    if is_best:
+        shutil.copyfile(path, os.path.join(directory, "model_best.ckpt"))
+        shutil.copyfile(os.path.join(directory, "checkpoint.json"),
+                        os.path.join(directory, "model_best.json"))
+    if save_all or round_idx in save_some_rounds:
+        shutil.copyfile(
+            path, os.path.join(directory, f"checkpoint_r{round_idx}.ckpt"))
+    return path
+
+
+def maybe_resume(directory: Optional[str], server, clients,
+                 cfg: ExperimentConfig,
+                 checkpoint_index: Optional[str] = None):
+    """Restore full state into freshly-initialized pytrees; validates the
+    config compatibility rules of checkpoint.py:93-139. Returns
+    (server, clients, best_prec1, resumed: bool)."""
+    if directory is None:
+        return server, clients, 0.0, False
+    name = "checkpoint.ckpt" if checkpoint_index is None \
+        else f"checkpoint_r{checkpoint_index}.ckpt"
+    path = os.path.join(directory, name)
+    meta_path = os.path.join(
+        directory, name.replace(".ckpt", ".json")
+        if checkpoint_index is None else "checkpoint.json")
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"No checkpoint at {path}")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    old = meta["arguments"]
+    new = _compat_meta(cfg)
+    for key in ("dataset", "batch_size", "arch", "algorithm",
+                "num_clients"):
+        if old[key] != new[key]:
+            raise ValueError(
+                f"Checkpoint incompatible: {key} was {old[key]!r}, "
+                f"config has {new[key]!r} (checkpoint.py:104-120 rule)")
+    if new["num_epochs"] is not None and old["num_epochs"] is not None \
+            and new["num_epochs"] < old["num_epochs"]:
+        raise ValueError(
+            "Checkpoint incompatible: num_epochs must not shrink "
+            f"({old['num_epochs']} -> {new['num_epochs']})")
+    with open(path, "rb") as f:
+        restored = serialization.from_bytes(
+            {"server": _unkey(server), "clients": clients}, f.read())
+    return (_rekey(restored["server"]), restored["clients"],
+            float(meta.get("best_prec1", 0.0)), True)

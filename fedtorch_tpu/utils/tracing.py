@@ -1,0 +1,45 @@
+"""Profiling / tracing.
+
+The reference only hand-times phases (SURVEY.md §5.1); the TPU build adds
+real profiler traces: ``jax.profiler`` emits a TensorBoard-compatible
+trace of the XLA execution (HLO ops, fusion, collective time on ICI),
+which is the per-phase attribution the hand timers cannot see inside one
+compiled round.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Context manager: profile everything inside to ``log_dir``."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named sub-span inside a trace (shows up on the TB timeline)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def device_memory_stats() -> dict:
+    """Per-device live-memory summary (HBM pressure check)."""
+    stats = {}
+    for d in jax.devices():
+        try:
+            s = d.memory_stats()
+            if s:
+                stats[str(d)] = {
+                    "bytes_in_use": s.get("bytes_in_use"),
+                    "peak_bytes_in_use": s.get("peak_bytes_in_use"),
+                    "bytes_limit": s.get("bytes_limit"),
+                }
+        except Exception:
+            pass
+    return stats

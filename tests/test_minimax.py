@@ -104,9 +104,30 @@ class TestDRFA:
                        for x in jax.tree.leaves(server.aux["kth_avg"]))
         assert kth_norm > 0
 
-    def test_lambda_weighted_sampling(self):
-        """Clients with larger lambda are sampled more often."""
+    def test_uniform_sampling_by_default(self):
+        """Reference parity: the DRFA loop samples uniformly
+        (drfa.py:71,216), so the default participation hook defers to the
+        engine (returns None)."""
         trainer, _ = _trainer("fedavg", drfa=True, num_clients=8, rate=0.25)
+        alg = trainer.algorithm
+        out = alg.participation(jax.random.key(0), 8, 2, jnp.asarray(1),
+                                {"lambda": jnp.ones(8) / 8})
+        assert out is None
+
+    def test_gamma_decays_per_round(self):
+        trainer, _ = _trainer("fedavg", drfa=True, drfa_gamma=0.1)
+        server, clients = trainer.init_state(jax.random.key(0))
+        assert float(server.aux["gamma"]) == pytest.approx(0.1)
+        server, clients, _ = trainer.run_round(server, clients)
+        assert float(server.aux["gamma"]) == pytest.approx(0.09)
+        server, clients, _ = trainer.run_round(server, clients)
+        assert float(server.aux["gamma"]) == pytest.approx(0.081)
+
+    def test_lambda_weighted_sampling_option(self):
+        """Paper-faithful sampling (drfa_lambda_sampling=True): larger
+        lambda sampled more often."""
+        trainer, _ = _trainer("fedavg", drfa=True, num_clients=8,
+                              rate=0.25, drfa_lambda_sampling=True)
         alg = trainer.algorithm
         lam = jnp.asarray([0.6, 0.2, 0.05, 0.05, 0.025, 0.025, 0.025,
                            0.025])

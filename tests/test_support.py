@@ -1,0 +1,184 @@
+"""Support subsystems: logging round-trip through the tools parser,
+checkpoint/resume (full round state), meters, and the CLI end-to-end."""
+import json
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from fedtorch_tpu.algorithms import make_algorithm
+from fedtorch_tpu.config import (
+    DataConfig, ExperimentConfig, FederatedConfig, ModelConfig, OptimConfig,
+    TrainConfig,
+)
+from fedtorch_tpu.data import build_federated_data
+from fedtorch_tpu.models import define_model
+from fedtorch_tpu.parallel import FederatedTrainer
+from fedtorch_tpu.tools import load_record_file, parse_records, smoothing
+from fedtorch_tpu.utils import (
+    AverageMeter, PhaseTimer, RunLogger, maybe_resume, save_checkpoint,
+)
+
+
+def _cfg(tmp_path, algorithm="scaffold", num_comms=3):
+    return ExperimentConfig(
+        data=DataConfig(dataset="synthetic", synthetic_dim=12,
+                        batch_size=10),
+        federated=FederatedConfig(federated=True, num_clients=4,
+                                  num_comms=num_comms,
+                                  online_client_rate=1.0,
+                                  algorithm=algorithm,
+                                  sync_type="local_step"),
+        model=ModelConfig(arch="logistic_regression"),
+        optim=OptimConfig(lr=0.2, weight_decay=0.0),
+        train=TrainConfig(local_step=3),
+        checkpoint=__import__("fedtorch_tpu.config", fromlist=["x"])
+        .CheckpointConfig(checkpoint_dir=str(tmp_path), debug=False),
+    ).finalize()
+
+
+class TestMeters:
+    def test_average_meter(self):
+        m = AverageMeter()
+        for v in (1.0, 2.0, 3.0):
+            m.update(v)
+        assert m.avg == 2.0 and m.max == 3.0 and m.min == 1.0
+
+    def test_phase_timer(self):
+        t = PhaseTimer()
+        t.start("round")
+        t.stop("round")
+        t.new_round()
+        t.add_comm(num_bytes=100.0)
+        s = t.summary()
+        assert "round" in s and s["comm_bytes_total"] == 100.0
+
+
+class TestLoggingRoundTrip:
+    def test_record_parse(self, tmp_path):
+        logger = RunLogger(str(tmp_path), debug=False)
+        logger.log_train(3, 1.5, 0.42, 0.91, 0.01, comm_bytes=1024,
+                         round_time=0.5)
+        logger.log_val(3, "test", 0.5, 0.88, 0.99, best=0.9)
+        logger.log_comm_time(3, 0.123)
+        rec = load_record_file(os.path.join(str(tmp_path), "record0"))
+        assert rec["train"][0]["loss"] == pytest.approx(0.42)
+        assert rec["train"][0]["comm_bytes"] == 1024
+        assert rec["val"][0]["top1"] == pytest.approx(0.88)
+        assert rec["val"][0]["mode"] == "test"
+        assert rec["comm"][0]["seconds"] == pytest.approx(0.123)
+
+    def test_parse_records_conditions(self, tmp_path):
+        run_dir = tmp_path / "lr-0.1_arch-mlp"
+        run_dir.mkdir()
+        RunLogger(str(run_dir), debug=False).log_train(
+            0, 0.0, 1.0, 0.1, 0.1)
+        runs = parse_records(str(tmp_path), conditions={"arch": "mlp"})
+        assert len(runs) == 1
+        assert parse_records(str(tmp_path),
+                             conditions={"arch": "resnet"}) == []
+
+    def test_smoothing(self):
+        out = smoothing(np.arange(20, dtype=float), window=5)
+        assert len(out) == 16
+        assert out[0] == pytest.approx(2.0)
+
+
+class TestCheckpoint:
+    def test_full_state_roundtrip(self, tmp_path):
+        """SCAFFOLD control variates must survive a resume — the gap the
+        reference has (SURVEY.md §5.4)."""
+        cfg = _cfg(tmp_path)
+        data = build_federated_data(cfg)
+        model = define_model(cfg, batch_size=10)
+        trainer = FederatedTrainer(cfg, model, make_algorithm(cfg),
+                                   data.train)
+        server, clients = trainer.init_state(jax.random.key(0))
+        for _ in range(2):
+            server, clients, _ = trainer.run_round(server, clients)
+        save_checkpoint(str(tmp_path / "run"), server, clients, cfg,
+                        best_prec1=0.5, is_best=True)
+
+        # fresh states, then restore
+        s2, c2 = trainer.init_state(jax.random.key(0))
+        s2, c2, best, resumed = maybe_resume(str(tmp_path / "run"), s2, c2,
+                                             cfg, None)
+        assert resumed and best == 0.5
+        assert int(s2.round) == 2
+        for a, b in zip(jax.tree.leaves(server.params),
+                        jax.tree.leaves(s2.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # client control variates restored exactly
+        for a, b in zip(jax.tree.leaves(clients.aux["control"]),
+                        jax.tree.leaves(c2.aux["control"])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # resumed run continues identically to the uninterrupted one
+        s_cont, c_cont, _ = trainer.run_round(server, clients)
+        s_res, c_res, _ = trainer.run_round(s2, c2)
+        for a, b in zip(jax.tree.leaves(s_cont.params),
+                        jax.tree.leaves(s_res.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_incompatible_config_rejected(self, tmp_path):
+        cfg = _cfg(tmp_path)
+        data = build_federated_data(cfg)
+        model = define_model(cfg, batch_size=10)
+        trainer = FederatedTrainer(cfg, model, make_algorithm(cfg),
+                                   data.train)
+        server, clients = trainer.init_state(jax.random.key(0))
+        save_checkpoint(str(tmp_path / "run"), server, clients, cfg, 0.0,
+                        False)
+        import dataclasses
+        bad = dataclasses.replace(
+            cfg, data=dataclasses.replace(cfg.data, batch_size=99))
+        with pytest.raises(ValueError, match="batch_size"):
+            maybe_resume(str(tmp_path / "run"), server, clients, bad, None)
+
+    def test_missing_checkpoint_raises(self, tmp_path):
+        cfg = _cfg(tmp_path)
+        with pytest.raises(FileNotFoundError):
+            maybe_resume(str(tmp_path / "nope"), None, None, cfg, None)
+
+
+class TestCLI:
+    def test_end_to_end_federated(self, tmp_path):
+        from fedtorch_tpu.cli import main
+        results = main([
+            "--federated", "true", "--data", "synthetic",
+            "--federated_type", "fedavg", "--num_comms", "3",
+            "--num_workers", "4", "--online_client_rate", "1.0",
+            "--federated_sync_type", "local_step", "--local_step", "3",
+            "--arch", "logistic_regression", "--lr", "0.2",
+            "--batch_size", "10", "--weight_decay", "0",
+            "--checkpoint", str(tmp_path / "ckpt"),
+            "--log_dir", str(tmp_path / "logs"), "--debug", "false",
+        ])
+        assert "best_top1" in results
+        # record file written & parseable
+        runs = parse_records(str(tmp_path / "ckpt"))
+        assert len(runs) == 1
+        assert len(runs[0]["records"]["train"]) == 3
+
+    def test_end_to_end_local_sgd(self, tmp_path):
+        from fedtorch_tpu.cli import main
+        results = main([
+            "--federated", "false", "--data", "synthetic",
+            "--num_workers", "4", "--num_epochs", "1",
+            "--local_step", "2", "--arch", "logistic_regression",
+            "--lr", "0.2", "--batch_size", "10",
+            "--checkpoint", str(tmp_path / "ckpt"),
+            "--debug", "false",
+        ])
+        assert results["rounds"] > 0
+
+    def test_config_mapping_derivations(self):
+        from fedtorch_tpu.cli import args_to_config, build_parser
+        args = build_parser().parse_args([
+            "--federated", "true", "--federated_type", "afl",
+            "--num_comms", "10", "--num_epochs_per_comm", "2",
+            "--online_client_rate", "0.5"])
+        cfg = args_to_config(args)
+        assert cfg.train.num_epochs == 10  # 2*10*0.5
+        assert cfg.train.local_step == 1   # afl coercion
