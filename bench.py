@@ -39,6 +39,25 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def probe_device(timeout_s: int = 300) -> bool:
+    """Check that the default JAX platform initializes, in a SUBPROCESS
+    with a timeout: the TPU relay in this container can wedge
+    indefinitely, and a hung bench is worse than a CPU fallback."""
+    import subprocess
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices())"],
+            timeout=timeout_s, capture_output=True)
+        ok = r.returncode == 0
+        if not ok:
+            log(f"device probe failed: {r.stderr.decode()[-200:]}")
+        return ok
+    except subprocess.TimeoutExpired:
+        log(f"device probe timed out after {timeout_s}s")
+        return False
+
+
 def measure_torch_baseline() -> float:
     try:
         import types
@@ -70,8 +89,17 @@ def measure_torch_baseline() -> float:
 
 
 def main():
+    fallback_cpu = not probe_device()
+    if fallback_cpu:
+        log("TPU unavailable — benchmarking on CPU (numbers will be low; "
+            "rerun when the TPU relay recovers)")
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
     import numpy as np
     import jax
+
+    if fallback_cpu:
+        jax.config.update("jax_platforms", "cpu")
 
     from fedtorch_tpu.algorithms import make_algorithm
     from fedtorch_tpu.config import (

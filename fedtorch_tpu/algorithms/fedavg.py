@@ -42,9 +42,13 @@ class FedAvg(FedAlgorithm):
                       client_losses=None):
         if self.cfg.federated.quantized:
             # downlink re-quantization of the summed delta (fedavg.py:54-64)
+            # — the fused pallas kernel when on TPU (one VMEM pass), XLA
+            # otherwise; the vmapped uplink path stays XLA (pallas_call
+            # has no batching rule)
+            from fedtorch_tpu.ops.pallas import fused_quantize_dequantize
             bits = self.cfg.federated.quantized_bits
             payload_sum = jax.tree.map(
-                lambda x: quantize_dequantize(x, bits), payload_sum)
+                lambda x: fused_quantize_dequantize(x, bits), payload_sum)
         new_params, new_opt = optim.server_step(
             server_params, payload_sum, server_opt,
             self.cfg.optim.lr_scale_at_sync, self.cfg.optim)

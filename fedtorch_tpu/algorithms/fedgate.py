@@ -64,8 +64,9 @@ class FedGate(FedAlgorithm):
                       payload_sum, *, online_idx, num_online_eff,
                       client_losses=None):
         if self.cfg.federated.quantized:
+            from fedtorch_tpu.ops.pallas import fused_quantize_dequantize
             payload_sum = jax.tree.map(
-                lambda x: quantize_dequantize(
+                lambda x: fused_quantize_dequantize(
                     x, self.cfg.federated.quantized_bits), payload_sum)
         new_params, new_opt = optim.server_step(
             server_params, payload_sum, server_opt,

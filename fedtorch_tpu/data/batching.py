@@ -47,21 +47,22 @@ def stack_partitions(features: np.ndarray, labels: np.ndarray,
     is always a *valid* sample of that client (masking is still applied
     for weighting, but a stray padded draw never injects another client's
     data)."""
+    from fedtorch_tpu.native import cyclic_pad_indices, gather_rows
     sizes = np.asarray([len(p) for p in partitions])
     if np.any(sizes == 0):
         raise ValueError("Every client needs at least one sample; got a "
                          f"zero-sized partition (sizes={sizes.tolist()})")
     if n_max is None:
         n_max = int(sizes.max())
-    xs, ys = [], []
-    for p in partitions:
-        idx = np.asarray(p)
-        reps = int(np.ceil(n_max / len(idx)))
-        idx_padded = np.tile(idx, reps)[:n_max]
-        xs.append(features[idx_padded])
-        ys.append(labels[idx_padded])
-    return ClientData(x=jnp.asarray(np.stack(xs)),
-                      y=jnp.asarray(np.stack(ys)),
+    # one flat padded index list -> one (native multithreaded) row gather
+    idx_all = np.concatenate([
+        cyclic_pad_indices(np.asarray(p, np.int32), n_max)
+        for p in partitions])
+    x = gather_rows(np.ascontiguousarray(features), idx_all)
+    y = gather_rows(np.ascontiguousarray(labels), idx_all)
+    C = len(partitions)
+    return ClientData(x=jnp.asarray(x.reshape((C, n_max) + x.shape[1:])),
+                      y=jnp.asarray(y.reshape((C, n_max) + y.shape[1:])),
                       sizes=jnp.asarray(sizes, jnp.int32))
 
 

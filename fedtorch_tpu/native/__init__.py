@@ -1,0 +1,4 @@
+from fedtorch_tpu.native.host_pipeline import (  # noqa: F401
+    HostPrefetcher, cyclic_pad_indices, gather_rows, load_library,
+    native_available, seeded_permutation,
+)

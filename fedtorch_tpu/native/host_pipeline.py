@@ -1,0 +1,144 @@
+"""ctypes bindings + background prefetcher for the native host pipeline.
+
+Auto-compiles ``pipeline.cpp`` with g++ on first use (cached next to the
+source); every entry point falls back to numpy when the toolchain or the
+library is unavailable, so the Python-only path always works.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import queue
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "pipeline.cpp")
+_LIB_PATH = os.path.join(os.path.dirname(__file__),
+                         "libfedtorch_host.so")
+_lib = None
+_lib_tried = False
+
+
+def _build_library() -> Optional[str]:
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-o", _LIB_PATH, _SRC,
+             "-lpthread"],
+            check=True, capture_output=True, timeout=120)
+        return _LIB_PATH
+    except Exception:
+        return None
+
+
+def load_library():
+    """Load (building if needed) the native library; None on failure."""
+    global _lib, _lib_tried
+    if _lib is not None or _lib_tried:
+        return _lib
+    _lib_tried = True
+    fresh = (os.path.exists(_LIB_PATH)
+             and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC))
+    path = _LIB_PATH if fresh else _build_library()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+        lib.ft_seeded_perm.argtypes = [
+            ctypes.c_int64, ctypes.c_uint64,
+            np.ctypeslib.ndpointer(np.int32)]
+        lib.ft_gather_rows.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.int32), ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int32]
+        lib.ft_cyclic_pad_indices.argtypes = [
+            np.ctypeslib.ndpointer(np.int32), ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.int32), ctypes.c_int64]
+        _lib = lib
+    except OSError:
+        _lib = None
+    return _lib
+
+
+def native_available() -> bool:
+    return load_library() is not None
+
+
+def seeded_permutation(n: int, seed: int) -> np.ndarray:
+    """Deterministic permutation of [0, n). Native Fisher-Yates when
+    available, numpy otherwise (different but equally valid streams)."""
+    lib = load_library()
+    out = np.empty(n, np.int32)
+    if lib is None:
+        return np.random.RandomState(seed).permutation(n).astype(np.int32)
+    lib.ft_seeded_perm(n, seed & 0xFFFFFFFFFFFFFFFF, out)
+    return out
+
+
+def gather_rows(src: np.ndarray, idx: np.ndarray,
+                num_threads: int = 0) -> np.ndarray:
+    """dst[k] = src[idx[k]] over leading-axis rows, multithreaded."""
+    lib = load_library()
+    idx = np.ascontiguousarray(idx, np.int32)
+    if lib is None:
+        return np.ascontiguousarray(src[idx])
+    src = np.ascontiguousarray(src)
+    out = np.empty((len(idx),) + src.shape[1:], src.dtype)
+    row_bytes = src.dtype.itemsize * int(np.prod(src.shape[1:], initial=1))
+    lib.ft_gather_rows(src.ctypes.data, row_bytes, idx, len(idx),
+                       out.ctypes.data, num_threads)
+    return out
+
+
+def cyclic_pad_indices(idx: np.ndarray, n_out: int) -> np.ndarray:
+    lib = load_library()
+    idx = np.ascontiguousarray(idx, np.int32)
+    if lib is None:
+        reps = -(-n_out // len(idx))
+        return np.tile(idx, reps)[:n_out]
+    out = np.empty(n_out, np.int32)
+    lib.ft_cyclic_pad_indices(idx, len(idx), out, n_out)
+    return out
+
+
+class HostPrefetcher:
+    """Background-thread double buffering: overlaps the host-side gather
+    of the next work item with device compute (the role of the
+    reference's DataLoader worker processes)."""
+
+    def __init__(self, produce_fn, depth: int = 2):
+        self._produce = produce_fn
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        step = 0
+        while not self._stop.is_set():
+            try:
+                item = self._produce(step)
+            except StopIteration:
+                self._q.put(None)
+                return
+            except BaseException as e:  # surface producer errors
+                self._q.put(e)
+                return
+            self._q.put(item)
+            step += 1
+
+    def next(self, timeout: float = 60.0):
+        item = self._q.get(timeout=timeout)
+        if isinstance(item, BaseException):
+            raise item
+        return item
+
+    def close(self):
+        self._stop.set()
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass

@@ -1,0 +1,89 @@
+// Native host-side data pipeline.
+//
+// The reference's input pipeline rides torch's C++ DataLoader machinery
+// (worker processes doing shuffle + collate). This is the TPU build's
+// equivalent native layer: seeded permutation generation and
+// multi-threaded row gather used to materialize the padded
+// [clients, N, ...] device-feed arrays (fedtorch_tpu/data/batching.py)
+// and per-epoch reshuffles without Python-loop overhead.
+//
+// Exposed via a plain C ABI consumed with ctypes
+// (fedtorch_tpu/native/host_pipeline.py); no pybind11 dependency.
+//
+// Build: g++ -O3 -march=native -shared -fPIC -o libfedtorch_host.so
+//        pipeline.cpp -lpthread
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// splitmix64: tiny, high-quality seeded generator for shuffles.
+static inline uint64_t splitmix64(uint64_t& state) {
+  uint64_t z = (state += 0x9E3779B97F4A7C15ULL);
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+  return z ^ (z >> 31);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Fisher-Yates permutation of [0, n) into out, deterministic in seed.
+void ft_seeded_perm(int64_t n, uint64_t seed, int32_t* out) {
+  for (int64_t i = 0; i < n; ++i) out[i] = static_cast<int32_t>(i);
+  uint64_t state = seed ^ 0xD1B54A32D192ED03ULL;
+  for (int64_t i = n - 1; i > 0; --i) {
+    uint64_t j = splitmix64(state) % static_cast<uint64_t>(i + 1);
+    int32_t tmp = out[i];
+    out[i] = out[j];
+    out[j] = tmp;
+  }
+}
+
+// Gather rows: dst[k] = src[idx[k]] for row_bytes-sized rows, using
+// num_threads workers (0 = hardware concurrency).
+void ft_gather_rows(const void* src, int64_t row_bytes,
+                    const int32_t* idx, int64_t n_idx, void* dst,
+                    int32_t num_threads) {
+  const char* s = static_cast<const char*>(src);
+  char* d = static_cast<char*>(dst);
+  int threads = num_threads > 0
+                    ? num_threads
+                    : static_cast<int32_t>(
+                          std::thread::hardware_concurrency());
+  if (threads < 1) threads = 1;
+  if (threads == 1 || n_idx < 4 * threads) {
+    for (int64_t k = 0; k < n_idx; ++k) {
+      std::memcpy(d + k * row_bytes, s + int64_t(idx[k]) * row_bytes,
+                  row_bytes);
+    }
+    return;
+  }
+  std::vector<std::thread> pool;
+  int64_t chunk = (n_idx + threads - 1) / threads;
+  for (int t = 0; t < threads; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = lo + chunk < n_idx ? lo + chunk : n_idx;
+    if (lo >= hi) break;
+    pool.emplace_back([=]() {
+      for (int64_t k = lo; k < hi; ++k) {
+        std::memcpy(d + k * row_bytes, s + int64_t(idx[k]) * row_bytes,
+                    row_bytes);
+      }
+    });
+  }
+  for (auto& th : pool) th.join();
+}
+
+// Cyclically pad an index list: out[k] = idx[k % n_idx] for k < n_out.
+// (stack_partitions' padding rule, batching.py:41-65.)
+void ft_cyclic_pad_indices(const int32_t* idx, int64_t n_idx,
+                           int32_t* out, int64_t n_out) {
+  for (int64_t k = 0; k < n_out; ++k) out[k] = idx[k % n_idx];
+}
+
+}  // extern "C"

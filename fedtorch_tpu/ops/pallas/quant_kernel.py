@@ -1,0 +1,99 @@
+"""Pallas TPU kernel: fused adaptive affine quantize->dequantize.
+
+The XLA path (ops/quantize.py) lowers the compression transform as
+separate min/max/mean reductions plus the elementwise round-trip — several
+HBM passes over each payload tensor. This kernel fuses the whole transform
+into ONE VMEM-resident pass: statistics and the round-trip happen while
+the block is on-chip, which matters because the aggregation path is
+HBM-bandwidth bound (one payload tensor per model parameter per round).
+
+Semantics are identical to ops.quantize.quantize_dequantize (the
+reference's flow_utils.py:169-212 affine scheme). Falls back to the XLA
+implementation off-TPU, for tensors too large for VMEM, and when the
+input is a vmap batch tracer (pallas_call has no batching rule) — so it
+is always safe to call.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from fedtorch_tpu.ops.quantize import quantize_dequantize as _xla_qdq
+
+_LANE = 128
+# per-tensor VMEM budget for the single-block kernel (bytes of f32)
+_MAX_VMEM_ELEMS = 2 * 1024 * 1024  # 8 MB of f32
+
+
+def _qdq_kernel(n_ref, x_ref, out_ref, *, num_bits: int):
+    import jax.numpy as jnp  # kernel-local alias
+
+    qmin = -(2.0 ** (num_bits - 1))
+    qmax = 2.0 ** (num_bits - 1) - 1.0
+    x = x_ref[:]
+    n = n_ref[0]
+    rows, cols = x.shape
+    flat_idx = (jax.lax.broadcasted_iota(jnp.int32, (rows, cols), 0) * cols
+                + jax.lax.broadcasted_iota(jnp.int32, (rows, cols), 1))
+    valid = flat_idx < n
+
+    big = jnp.asarray(jnp.finfo(jnp.float32).max)
+    mn = jnp.min(jnp.where(valid, x, big))
+    mx = jnp.max(jnp.where(valid, x, -big))
+    mean = jnp.sum(jnp.where(valid, x, 0.0)) / n.astype(jnp.float32)
+
+    scale = (mx - mn) / (qmax - qmin)
+    scale = jnp.where(scale == 0.0, 0.001, scale)
+    zp = jnp.trunc(jnp.clip(qmin - (mn - mean) / scale, qmin, qmax))
+    q = jnp.clip(jnp.round(zp + (x - mean) / scale), qmin, qmax)
+    out_ref[:] = scale * (q - zp) + mean
+
+
+@functools.partial(jax.jit, static_argnames=("num_bits",))
+def _pallas_qdq_padded(x2d: jnp.ndarray, n: jnp.ndarray,
+                       num_bits: int) -> jnp.ndarray:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pl.pallas_call(
+        functools.partial(_qdq_kernel, num_bits=num_bits),
+        out_shape=jax.ShapeDtypeStruct(x2d.shape, jnp.float32),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+    )(n, x2d)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def _is_batch_traced(x) -> bool:
+    from jax.interpreters import batching
+    return isinstance(x, batching.BatchTracer)
+
+
+def fused_quantize_dequantize(x: jnp.ndarray, num_bits: int = 8,
+                              force_pallas: bool = False) -> jnp.ndarray:
+    """Drop-in replacement for ops.quantize.quantize_dequantize."""
+    n = x.size
+    use_pallas = (force_pallas
+                  or (_on_tpu() and n <= _MAX_VMEM_ELEMS)) \
+        and not _is_batch_traced(x)
+    if not use_pallas:
+        return _xla_qdq(x, num_bits)
+    rows = -(-n // _LANE)
+    # pad rows to the f32 sublane multiple (8)
+    rows = -(-rows // 8) * 8
+    padded = jnp.zeros((rows * _LANE,), jnp.float32)
+    padded = padded.at[:n].set(x.reshape(-1).astype(jnp.float32))
+    out = _pallas_qdq_padded(padded.reshape(rows, _LANE),
+                             jnp.asarray([n], jnp.int32), num_bits)
+    return out.reshape(-1)[:n].reshape(x.shape).astype(x.dtype)

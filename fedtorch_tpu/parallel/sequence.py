@@ -1,0 +1,128 @@
+"""Sequence/context parallelism: ring attention over the device mesh.
+
+The reference has no attention at all — its only sequence model is a
+char-GRU (SURVEY.md §5.7) — so long-context support is new, TPU-first
+scope: exact blockwise attention with the sequence axis sharded over a
+mesh axis and K/V blocks rotating around the ring via ``lax.ppermute``
+(one ICI hop per step, compute overlapped with the rotation by XLA's
+scheduler), in the style of Ring Attention (arXiv:2310.01889) with
+online-softmax accumulation (arXiv:2112.05682).
+
+Layout: ``q, k, v: [batch, seq, heads, head_dim]`` with ``seq`` sharded
+over the ``sp`` mesh axis inside ``shard_map``. Each of the S ring steps
+processes the local Q block against one rotating K/V block, maintaining
+running (max, sum, accumulator) statistics, so the full [seq, seq] score
+matrix never materializes — memory is O(seq_local^2 / S) per device.
+
+``causal=True`` masks by absolute position, so the result is exactly
+standard causal attention regardless of sharding.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _block_attend(q, k, v, m_prev, l_prev, o_prev, q_offset, k_offset,
+                  causal: bool, scale: float):
+    """One online-softmax block update.
+
+    q: [B, Sq, H, D], k/v: [B, Sk, H, D]; running stats m/l: [B, H, Sq],
+    o: [B, Sq, H, D]. Offsets are absolute sequence positions of the
+    blocks for causal masking."""
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale  # [B,H,Sq,Sk]
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[1])
+        k_pos = k_offset + jnp.arange(k.shape[1])
+        mask = q_pos[:, None] >= k_pos[None, :]
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    m_block = jnp.max(scores, axis=-1)                     # [B,H,Sq]
+    m_new = jnp.maximum(m_prev, m_block)
+    # guard fully-masked rows (all -inf)
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(scores - m_safe[..., None])
+    p = jnp.where(jnp.isfinite(scores), p, 0.0)
+    correction = jnp.where(jnp.isfinite(m_prev),
+                           jnp.exp(m_prev - m_safe), 0.0)
+    l_new = l_prev * correction + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    o_new = o_prev * correction.transpose(0, 2, 1)[..., None] + pv
+    return m_new, l_new, o_new
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
+                          scale: float):
+    """Per-shard body (inside shard_map): rotate K/V around the ring."""
+    num_shards = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    seq_local = q.shape[1]
+    q_offset = my_idx * seq_local
+
+    # derive initial stats from q so they carry shard_map's varying-axis
+    # type (a plain jnp.full would be 'unvarying' and fail scan typing)
+    zeros_bhq = q[..., 0].transpose(0, 2, 1) * 0.0
+    m0 = zeros_bhq - jnp.inf
+    l0 = zeros_bhq
+    o0 = jnp.zeros_like(q)
+
+    def step(carry, s):
+        k_blk, v_blk, m, l, o = carry
+        # the block currently held came from shard (my_idx - s) % n
+        src = (my_idx - s) % num_shards
+        m, l, o = _block_attend(q, k_blk, v_blk, m, l, o, q_offset,
+                                src * seq_local, causal, scale)
+        # rotate: send to next shard, receive from previous
+        perm = [(i, (i + 1) % num_shards) for i in range(num_shards)]
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (k_blk, v_blk, m, l, o), None
+
+    # scan the first S-1 blocks (each followed by a rotation), then attend
+    # the final received block outside the scan — saving one useless ICI
+    # rotation whose result would be discarded
+    (k_last, v_last, m, l, o), _ = jax.lax.scan(
+        step, (k, v, m0, l0, o0), jnp.arange(num_shards - 1))
+    src_last = (my_idx - (num_shards - 1)) % num_shards
+    m, l, o = _block_attend(q, k_last, v_last, m, l, o, q_offset,
+                            src_last * seq_local, causal, scale)
+    l_safe = jnp.maximum(l, 1e-20)
+    return o / l_safe.transpose(0, 2, 1)[..., None]
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   mesh: Mesh, axis_name: str = "sp",
+                   causal: bool = False,
+                   scale: Optional[float] = None) -> jnp.ndarray:
+    """Exact attention with the sequence axis sharded over ``axis_name``.
+
+    Inputs/outputs [batch, seq, heads, head_dim]; seq must divide evenly
+    over the mesh axis."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    spec = P(None, axis_name, None, None)
+    shard_fn = jax.shard_map(
+        functools.partial(_ring_attention_local, axis_name=axis_name,
+                          causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    sh = NamedSharding(mesh, spec)
+    q, k, v = (jax.device_put(x, sh) for x in (q, k, v))
+    return shard_fn(q, k, v)
+
+
+def reference_attention(q, k, v, causal: bool = False,
+                        scale: Optional[float] = None):
+    """Dense single-device attention (the correctness oracle)."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        s = q.shape[1]
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)

@@ -1,0 +1,60 @@
+"""Ring attention (sequence parallelism) correctness tests."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import Mesh
+
+from fedtorch_tpu.parallel.sequence import (
+    reference_attention, ring_attention,
+)
+
+
+def _mesh(n):
+    return Mesh(np.asarray(jax.devices()[:n]), ("sp",))
+
+
+def _qkv(b=2, s=32, h=4, d=16, seed=0):
+    ks = jax.random.split(jax.random.key(seed), 3)
+    shape = (b, s, h, d)
+    return tuple(jax.random.normal(k, shape) for k in ks)
+
+
+@pytest.mark.parametrize("n_shards", [1, 2, 8])
+def test_matches_dense_attention(n_shards):
+    q, k, v = _qkv()
+    out = ring_attention(q, k, v, _mesh(n_shards))
+    ref = reference_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("n_shards", [2, 8])
+def test_causal_matches_dense(n_shards):
+    q, k, v = _qkv(seed=3)
+    out = ring_attention(q, k, v, _mesh(n_shards), causal=True)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_long_sequence_sharded():
+    """A sequence too big to be comfortable dense still runs sharded."""
+    q, k, v = _qkv(b=1, s=1024, h=2, d=8, seed=5)
+    out = ring_attention(q, k, v, _mesh(8), causal=True)
+    assert out.shape == (1, 1024, 2, 8)
+    assert bool(jnp.all(jnp.isfinite(out)))
+    # spot-check the first 64 positions against dense
+    ref = reference_attention(q[:, :64], k[:, :64], v[:, :64], causal=True)
+    np.testing.assert_allclose(np.asarray(out[:, :64]), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_jit_compatible():
+    mesh = _mesh(2)
+    q, k, v = _qkv(s=16)
+    f = jax.jit(lambda a, b, c: ring_attention(a, b, c, mesh))
+    out = f(q, k, v)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(reference_attention(q, k, v)),
+                               atol=2e-5, rtol=2e-5)
