@@ -145,6 +145,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-c", "--checkpoint", default="./checkpoint/")
     p.add_argument("--save_all_models", type=str2bool, default=False)
     p.add_argument("--save_some_models", default="1,29,59")
+    p.add_argument("--check_model_at_sync", type=str2bool, default=False)
+    p.add_argument("--track_model_aggregation", type=str2bool,
+                   default=False)
     p.add_argument("--log_dir", default="./logdir/")
     p.add_argument("--experiment", default=None)
     # device / mesh (replaces parameters.py:225-236 MPI block)
@@ -244,7 +247,9 @@ def args_to_config(args) -> ExperimentConfig:
             checkpoint_index=args.checkpoint_index,
             save_all_models=args.save_all_models,
             save_some_models=args.save_some_models,
-            log_dir=args.log_dir, debug=args.debug),
+            log_dir=args.log_dir, debug=args.debug,
+            check_model_at_sync=args.check_model_at_sync,
+            track_model_aggregation=args.track_model_aggregation),
         mesh=MeshConfig(
             backend=args.backend, num_devices=args.num_devices,
             coordinator_address=args.coordinator_address,
@@ -269,12 +274,14 @@ def run_experiment(cfg: ExperimentConfig,
         init_multihost,
     )
     from fedtorch_tpu.utils import (
-        PhaseTimer, RunLogger, init_checkpoint_dir, maybe_resume,
-        save_checkpoint,
+        PhaseTimer, RunLogger, aggregation_tracking, init_checkpoint_dir,
+        maybe_resume, model_norms, save_checkpoint,
     )
 
-    if cfg.mesh.backend == "cpu" \
-            and os.environ.get("JAX_PLATFORMS") != "cpu":
+    if cfg.mesh.backend == "cpu" or os.environ.get(
+            "JAX_PLATFORMS", "").strip().lower() == "cpu":
+        # the env var alone is not enough: a site hook may have already
+        # overridden jax_platforms to a TPU proxy at interpreter start
         jax.config.update("jax_platforms", "cpu")
     init_multihost(cfg.mesh)
 
@@ -323,11 +330,25 @@ def run_experiment(cfg: ExperimentConfig,
     start_round = int(server.round)
     for r in range(start_round, cfg.federated.num_comms):
         timer.new_round()
+        # copy, not alias: the round jit donates the server buffers
+        prev_params = jax.tree.map(jnp.copy, server.params) \
+            if cfg.checkpoint.track_model_aggregation else None
         timer.start("round")
         server, clients, metrics = trainer.run_round(server, clients)
         jax.block_until_ready(server.params)
         round_time = timer.stop("round")
         timer.add_comm(num_bytes=float(metrics.comm_bytes))
+
+        if cfg.checkpoint.check_model_at_sync:
+            norms = model_norms(server.params)
+            logger.log(f"Round {r}: server model l2="
+                       f"{float(norms['l2']):.4f} "
+                       f"max|w|={float(norms['max_abs']):.4f}")
+        if prev_params is not None:
+            tr = aggregation_tracking(prev_params, server.params)
+            logger.log(f"Round {r}: aggregation cosine="
+                       f"{float(tr['cosine']):.6f} "
+                       f"distance={float(tr['distance']):.6f}")
 
         n_online = float(jnp.sum(metrics.online_mask))
         loss = float(jnp.sum(metrics.train_loss) / max(n_online, 1))

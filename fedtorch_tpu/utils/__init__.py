@@ -2,6 +2,9 @@ from fedtorch_tpu.utils.checkpoint import (  # noqa: F401
     get_checkpoint_folder_name, init_checkpoint_dir, maybe_resume,
     save_checkpoint,
 )
+from fedtorch_tpu.utils.diagnostics import (  # noqa: F401
+    aggregation_tracking, check_finite, model_norms,
+)
 from fedtorch_tpu.utils.logging import RunLogger  # noqa: F401
 from fedtorch_tpu.utils.meters import (  # noqa: F401
     AverageMeter, PhaseTimer, define_local_training_tracker,

@@ -1,0 +1,57 @@
+"""Training sanity checks.
+
+Covers the role of ``logs/check_training.py`` (the reference's substitute
+for tests, SURVEY.md §4.2) with deliberately adjusted quantities for the
+one-program-per-round design:
+
+* ``model_norms`` — weight-norm reporting at sync (the reference's
+  check_model_at_sync, check_training.py:22-37, also prints per-batch
+  gradient norms; per-batch gradients live inside the jitted scan here,
+  so the norm check applies to the aggregated model).
+* ``aggregation_tracking`` — cosine/distance between the PRE- and
+  POST-aggregation server models. The reference's
+  track_model_aggregation (check_training.py:43-76) instead tracks
+  gradient-direction cosine and distance from the *initial* model; the
+  pre/post form answers the same "is aggregation doing something sane"
+  question per round without holding the initial model forever.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def model_norms(params) -> Dict[str, jnp.ndarray]:
+    """Global l2 norm + per-leaf max abs (check_training.py:22-37)."""
+    leaves = jax.tree.leaves(params)
+    sq = sum(jnp.sum(jnp.square(x)) for x in leaves)
+    mx = jnp.max(jnp.stack([jnp.max(jnp.abs(x)) for x in leaves]))
+    return {"l2": jnp.sqrt(sq), "max_abs": mx}
+
+
+@jax.jit
+def aggregation_tracking(old_params, new_params) -> Dict[str, jnp.ndarray]:
+    """Cosine similarity and l2 distance between the model before and
+    after aggregation (check_training.py:43-76)."""
+    flat_old = jnp.concatenate(
+        [x.ravel() for x in jax.tree.leaves(old_params)])
+    flat_new = jnp.concatenate(
+        [x.ravel() for x in jax.tree.leaves(new_params)])
+    denom = jnp.maximum(
+        jnp.linalg.norm(flat_old) * jnp.linalg.norm(flat_new), 1e-12)
+    return {
+        "cosine": jnp.vdot(flat_old, flat_new) / denom,
+        "distance": jnp.linalg.norm(flat_new - flat_old),
+        "rel_change": jnp.linalg.norm(flat_new - flat_old)
+        / jnp.maximum(jnp.linalg.norm(flat_old), 1e-12),
+    }
+
+
+def check_finite(params) -> bool:
+    """Divergence guard: all leaves finite (the implicit check the
+    reference's norm prints served)."""
+    return all(bool(jnp.all(jnp.isfinite(x)))
+               for x in jax.tree.leaves(params))
