@@ -29,19 +29,72 @@ def _pad_batches(x: np.ndarray, y: np.ndarray, batch_size: int):
     n = x.shape[0]
     n_batches = max((n + batch_size - 1) // batch_size, 1)
     pad = n_batches * batch_size - n
-    x = np.concatenate([x, x[:pad]]) if pad else x
-    y = np.concatenate([y, y[:pad]]) if pad else y
+    if pad:
+        # cycle rows so padding works even when pad > n (tiny eval sets)
+        idx = np.arange(pad) % n
+        x = np.concatenate([x, x[idx]])
+        y = np.concatenate([y, y[idx]])
     mask = np.concatenate([np.ones(n), np.zeros(pad)])
     return (x.reshape((n_batches, batch_size) + x.shape[1:]),
             y.reshape(n_batches, batch_size),
             mask.reshape(n_batches, batch_size))
 
 
-def evaluate(model: ModelDef, params, x: np.ndarray, y: np.ndarray,
-             batch_size: int = 256) -> EvalResult:
-    """Server-side test evaluation (eval.py:83-99 inference loop),
-    scanning over batches on device with padding masks."""
+def _ascent_on_batches(model: ModelDef, params, bx, by, bm,
+                       step_size: float = 0.01):
+    """Noise-ascent core over pre-padded batches (masked so padding rows
+    contribute nothing to the ascent gradient)."""
+    from fedtorch_tpu.core.losses import per_sample_loss
+
+    @jax.jit
+    def run(params, bx, by, bm):
+        def body(params, batch):
+            xb, yb, mb = batch
+
+            def loss_fn(noise):
+                p = dict(params, noise=noise)
+                logits = model.apply(p, xb)
+                per = per_sample_loss(logits, yb, model.is_regression)
+                return jnp.sum(per * mb) / jnp.maximum(jnp.sum(mb), 1.0)
+
+            g = jax.grad(loss_fn)(params["noise"])
+            noise = params["noise"] + step_size * g
+            norm = jnp.linalg.norm(noise)
+            noise = jnp.where(norm > 1.0, noise / norm, noise)
+            return dict(params, noise=noise), None
+
+        params, _ = jax.lax.scan(body, params, (bx, by, bm))
+        return params
+
+    return run(params, bx, by, bm)
+
+
+def robust_noise_ascent(model: ModelDef, params, x: np.ndarray,
+                        y: np.ndarray, batch_size: int = 256,
+                        step_size: float = 0.01):
+    """Adversarial evaluation prelude for robust_* archs
+    (eval.py:59-68): one gradient-ascent pass over the eval set on the
+    learnable input-noise parameter, projecting onto the unit ball after
+    each step. Returns params with the adversarially-updated noise."""
+    if not model.has_noise_param:
+        return params
     bx, by, bm = _pad_batches(np.asarray(x), np.asarray(y), batch_size)
+    return _ascent_on_batches(model, params, jnp.asarray(bx),
+                              jnp.asarray(by), jnp.asarray(bm), step_size)
+
+
+def evaluate(model: ModelDef, params, x: np.ndarray, y: np.ndarray,
+             batch_size: int = 256,
+             robust_ascent: bool = True) -> EvalResult:
+    """Server-side test evaluation (eval.py:83-99 inference loop),
+    scanning over batches on device with padding masks. Robust archs get
+    the adversarial noise-ascent prelude (eval.py:59-68) unless
+    ``robust_ascent=False``."""
+    bx, by, bm = _pad_batches(np.asarray(x), np.asarray(y), batch_size)
+    bx, by, bm = jnp.asarray(bx), jnp.asarray(by), jnp.asarray(bm)
+    if model.has_noise_param and robust_ascent:
+        # pad/upload once; the ascent shares the same device batches
+        params = _ascent_on_batches(model, params, bx, by, bm)
 
     @jax.jit
     def run(params, bx, by, bm):

@@ -1,0 +1,222 @@
+"""Head-to-head baseline reproduction vs the reference (BASELINE.md
+procedure: reproduce the reference run configs numerically, then compare
+wall-clock).
+
+Runs the reference's OWN centered-mode implementation (torch, from
+/root/reference, with minimal torch-2.x compatibility shims) and
+fedtorch_tpu with the matched configuration on the IDENTICAL dataset (the
+reference's generated synthetic shards are loaded directly), then prints
+an accuracy/wall-clock table.
+
+Usage:  python scripts/compare_reference.py [--rounds 10] [--algos ...]
+Needs /root/reference mounted; runs offline (synthetic data only).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+import types
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REF = "/root/reference"
+WORKDIR = "/tmp/fedtorch_compare"
+
+
+def install_reference_shims():
+    """Make the torch-1.6-era reference run under torch 2.x on one core."""
+    for name in ("torchvision", "torchvision.datasets",
+                 "torchvision.transforms"):
+        sys.modules.setdefault(name, types.ModuleType(name))
+    sys.modules["torchvision"].datasets = sys.modules[
+        "torchvision.datasets"]
+    sys.modules["torchvision"].transforms = sys.modules[
+        "torchvision.transforms"]
+    sys.path.insert(0, REF)
+
+    import torch
+    import torch.utils.data as tud
+
+    class _DL(tud.DataLoader):  # single-process loaders on a 1-core host
+        def __init__(self, *a, **kw):
+            kw["num_workers"] = 0
+            kw["pin_memory"] = False
+            super().__init__(*a, **kw)
+
+    tud.DataLoader = _DL
+    torch.utils.data.DataLoader = _DL
+    # torch>=2 zero_grad defaults to set_to_none=True; the reference
+    # mutates .grad.data in place and needs zeroed tensors
+    _zero = torch.optim.Optimizer.zero_grad
+    torch.optim.Optimizer.zero_grad = \
+        lambda self, set_to_none=False: _zero(self, set_to_none=False)
+
+    # .view on non-contiguous slices + formatting 1-elem tensors
+    import fedtorch.components.metrics as M
+
+    def _accuracy(output, target, topk=(1,), rnn=False):
+        if rnn:
+            output = output.permute(0, 2, 1).reshape(-1, output.size(1))
+            target = target.reshape(-1)
+        maxk = max(topk)
+        batch_size = target.size(0)
+        _, pred = output.topk(maxk, 1, True, True)
+        pred = pred.t()
+        correct = pred.eq(target.view(1, -1).expand_as(pred))
+        return [correct[:k].contiguous().reshape(-1).float().sum(0)
+                .mul_(100.0 / batch_size) for k in topk]
+
+    M.accuracy = _accuracy
+
+
+def reference_argv(algo: str, rounds: int, extra=()):
+    argv = [
+        "main_centered.py", "--federated", "True",
+        "--federated_type", algo if algo != "drfa" else "fedavg",
+        "--data", "synthetic", "--data_dir", f"{WORKDIR}/data",
+        "--num_comms", str(rounds), "--online_client_rate", "1.0",
+        "--federated_sync_type", "local_step", "--local_step", "5",
+        "--arch", "logistic_regression", "--lr", "0.1",
+        "--batch_size", "20", "--weight_decay", "0.0001",
+        "--iid_data", "False", "--num_workers", "4",
+        "--on_cuda", "False", "--debug", "True",
+        "--lr_schedule_scheme", "custom_multistep",
+        "--checkpoint", f"{WORKDIR}/ckpt",
+        "--is_distributed", "False", "--blocks", "4",
+        "--manual_seed", "6",
+    ]
+    if algo == "drfa":
+        argv += ["--federated_drfa", "True", "--drfa_gamma", "0.1"]
+    return argv + list(extra)
+
+
+def run_reference(algo: str, rounds: int):
+    import contextlib
+    install_reference_shims()
+    # the reference's synthetic generator ignores its own seed param and
+    # draws from the GLOBAL numpy RNG (federated_datasets.py:204-212);
+    # seed it so the generated shards are reproducible & non-degenerate
+    import numpy as np
+    np.random.seed(20260728)
+    sys.argv = reference_argv(algo, rounds)
+    from fedtorch.parameters import get_args
+    args = get_args()
+    from main_centered import main as ref_main
+    t0 = time.time()
+    with open(f"{WORKDIR}/ref_{algo}.log", "w") as f, \
+            contextlib.redirect_stdout(f):
+        ref_main(args)
+    wall = time.time() - t0
+    return wall
+
+
+def load_reference_data():
+    import numpy as np
+    import torch
+    base = f"{WORKDIR}/data/synthetic/synthetic0.0-0.0"
+    cx, cy = [], []
+    i = 0
+    while os.path.exists(f"{base}/Client_{i}.pt"):
+        x, y = torch.load(f"{base}/Client_{i}.pt")
+        cx.append(np.asarray(x))
+        cy.append(np.asarray(y))
+        i += 1
+    tx, ty = torch.load(f"{base}/Test.pt")
+    return cx, cy, np.asarray(tx), np.asarray(ty)
+
+
+def run_ours(algo: str, rounds: int, cx, cy, tx, ty,
+             use_tpu: bool = False):
+    import jax
+    if not use_tpu:
+        # force cpu WITHOUT calling jax.default_backend() — merely probing
+        # the default backend would initialize the (possibly wedged) TPU
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+    import numpy as np
+    import jax.numpy as jnp
+    sys.path.insert(0, REPO)
+    from fedtorch_tpu.algorithms import make_algorithm
+    from fedtorch_tpu.config import (
+        DataConfig, ExperimentConfig, FederatedConfig, ModelConfig,
+        OptimConfig, TrainConfig,
+    )
+    from fedtorch_tpu.data.batching import stack_partitions
+    from fedtorch_tpu.models import define_model
+    from fedtorch_tpu.parallel import FederatedTrainer, evaluate
+
+    sizes = [len(y) for y in cy]
+    feats, labels = np.concatenate(cx), np.concatenate(cy)
+    offs = np.concatenate([[0], np.cumsum(sizes)])
+    parts = [np.arange(offs[i], offs[i + 1]) for i in range(len(sizes))]
+    data = stack_partitions(feats, labels, parts)
+
+    cfg = ExperimentConfig(
+        data=DataConfig(dataset="synthetic", synthetic_dim=feats.shape[1],
+                        batch_size=20),
+        federated=FederatedConfig(
+            federated=True, num_clients=len(sizes), num_comms=rounds,
+            online_client_rate=1.0,
+            algorithm=algo if algo != "drfa" else "fedavg",
+            drfa=(algo == "drfa"), sync_type="local_step"),
+        model=ModelConfig(arch="logistic_regression"),
+        optim=OptimConfig(lr=0.1, weight_decay=1e-4),
+        train=TrainConfig(local_step=5),
+    ).finalize()
+    model = define_model(cfg, batch_size=20)
+    trainer = FederatedTrainer(cfg, model, make_algorithm(cfg), data)
+    server, clients = trainer.init_state(jax.random.key(6))
+    trainer.run_round(server, clients)  # compile warmup
+    server, clients = trainer.init_state(jax.random.key(6))
+    t0 = time.time()
+    for _ in range(rounds):
+        server, clients, _ = trainer.run_round(server, clients)
+    jax.block_until_ready(server.params)
+    wall = time.time() - t0
+    tr = evaluate(model, server.params, feats, labels, batch_size=200)
+    te = evaluate(model, server.params, tx, ty, batch_size=200)
+    return wall, float(tr.top1) * 100, float(te.top1) * 100
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=10)
+    ap.add_argument("--algos", nargs="+",
+                    default=["fedavg", "scaffold", "fedgate"])
+    ap.add_argument("--tpu", action="store_true",
+                    help="run ours on the default (TPU) platform")
+    args = ap.parse_args()
+    os.makedirs(WORKDIR, exist_ok=True)
+
+    def ref_final_metrics(algo):
+        import re
+        last = {}
+        with open(f"{WORKDIR}/ref_{algo}.log") as f:
+            for line in f:
+                m = re.search(
+                    r"(Global performance for train|Test) at batch.*"
+                    r"Prec@1: ([\d.]+).*Loss: ([\d.]+)", line)
+                if m:
+                    key = "train" if "train" in m.group(1) else "test"
+                    last[key] = float(m.group(2))
+        return last
+
+    print(f"{'algo':<10} {'ref wall':>9} {'ours wall':>10} {'speedup':>8} "
+          f"{'ref tr/te%':>12} {'ours tr/te%':>12}")
+    for algo in args.algos:
+        ref_wall = run_reference(algo, args.rounds)
+        refm = ref_final_metrics(algo)
+        cx, cy, tx, ty = load_reference_data()
+        ours_wall, tr, te = run_ours(algo, args.rounds, cx, cy, tx, ty,
+                                     use_tpu=args.tpu)
+        print(f"{algo:<10} {ref_wall:>8.2f}s {ours_wall:>9.2f}s "
+              f"{ref_wall / max(ours_wall, 1e-9):>7.1f}x "
+              f"{refm.get('train', 0):>5.1f}/{refm.get('test', 0):<5.1f} "
+              f"{tr:>5.1f}/{te:<5.1f}")
+
+
+if __name__ == "__main__":
+    main()

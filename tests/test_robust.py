@@ -1,0 +1,80 @@
+"""Robust architectures: training-time noise ascent and adversarial
+evaluation (eval.py:59-68 parity)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from fedtorch_tpu.algorithms import make_algorithm
+from fedtorch_tpu.config import (
+    DataConfig, ExperimentConfig, FederatedConfig, ModelConfig, OptimConfig,
+    TrainConfig,
+)
+from fedtorch_tpu.data import build_federated_data
+from fedtorch_tpu.models import define_model
+from fedtorch_tpu.parallel import FederatedTrainer, evaluate
+from fedtorch_tpu.parallel.evaluate import robust_noise_ascent
+
+
+def _setup():
+    cfg = ExperimentConfig(
+        data=DataConfig(dataset="synthetic", synthetic_dim=20,
+                        batch_size=16),
+        federated=FederatedConfig(federated=True, num_clients=4,
+                                  num_comms=5, online_client_rate=1.0,
+                                  algorithm="fedavg",
+                                  sync_type="local_step"),
+        model=ModelConfig(arch="robust_logistic_regression"),
+        optim=OptimConfig(lr=0.2, weight_decay=0.0),
+        train=TrainConfig(local_step=4),
+    ).finalize()
+    data = build_federated_data(cfg)
+    model = define_model(cfg, batch_size=16)
+    return cfg, data, model
+
+
+def test_training_does_noise_ascent():
+    cfg, data, model = _setup()
+    trainer = FederatedTrainer(cfg, model, make_algorithm(cfg), data.train)
+    server, clients = trainer.init_state(jax.random.key(0))
+    noise0 = np.asarray(server.params["noise"])
+    for _ in range(3):
+        server, clients, _ = trainer.run_round(server, clients)
+    noise1 = np.asarray(server.params["noise"])
+    assert not np.allclose(noise0, noise1)  # noise moved (ascent)
+    # training still converges despite the adversary
+    res = evaluate(model, server.params, data.test_x, data.test_y,
+                   robust_ascent=False)
+    assert float(res.top1) > 0.5
+
+
+def test_eval_ascent_increases_loss_and_projects():
+    cfg, data, model = _setup()
+    # train a few rounds first so the loss is noise-sensitive
+    trainer = FederatedTrainer(cfg, model, make_algorithm(cfg), data.train)
+    server, clients = trainer.init_state(jax.random.key(1))
+    for _ in range(3):
+        server, clients, _ = trainer.run_round(server, clients)
+    params = server.params
+
+    clean = evaluate(model, params, data.test_x, data.test_y,
+                     robust_ascent=False)
+    adv_params = robust_noise_ascent(model, params, data.test_x,
+                                     data.test_y)
+    adv = evaluate(model, adv_params, data.test_x, data.test_y,
+                   robust_ascent=False)
+    # adversarial noise must not decrease the loss
+    assert float(adv.loss) >= float(clean.loss) - 1e-5
+    # and stays within the unit ball (eval.py:66-68)
+    assert float(jnp.linalg.norm(adv_params["noise"])) <= 1.0 + 1e-5
+
+
+def test_evaluate_applies_ascent_by_default():
+    cfg, data, model = _setup()
+    trainer = FederatedTrainer(cfg, model, make_algorithm(cfg), data.train)
+    server, clients = trainer.init_state(jax.random.key(2))
+    for _ in range(2):
+        server, clients, _ = trainer.run_round(server, clients)
+    res_adv = evaluate(model, server.params, data.test_x, data.test_y)
+    res_clean = evaluate(model, server.params, data.test_x, data.test_y,
+                         robust_ascent=False)
+    assert float(res_adv.loss) >= float(res_clean.loss) - 1e-5
