@@ -112,6 +112,11 @@ def main():
 
     log(f"devices: {jax.devices()}")
 
+    from fedtorch_tpu.config import MeshConfig
+    # bf16 conv/matmul compute on the MXU (params/norms stay f32);
+    # override with BENCH_DTYPE=float32 for a full-precision run
+    dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
+    log(f"compute dtype: {dtype}")
     cfg = ExperimentConfig(
         data=DataConfig(dataset="cifar10", batch_size=BATCH_SIZE),
         federated=FederatedConfig(
@@ -121,6 +126,7 @@ def main():
         model=ModelConfig(arch="resnet20"),
         optim=OptimConfig(lr=0.1, in_momentum=True),
         train=TrainConfig(local_step=LOCAL_STEPS),
+        mesh=MeshConfig(compute_dtype=dtype),
     ).finalize()
 
     # CIFAR-10-shaped synthetic client shards (zero-egress container:

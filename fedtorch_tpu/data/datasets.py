@@ -213,17 +213,23 @@ def load_emnist(data_dir: str, full: bool = False,
     if os.path.exists(test_p):
         test_x, test_y, _ = read(test_p)
     else:
-        test_x, test_y = train_x[:1], train_y[:1]
+        import sys as _sys
+        print(f"warning: {test_p} missing — using a 256-sample slice of "
+              "the training data as the test set", file=_sys.stderr)
+        test_x, test_y = train_x[:256], train_y[:256]
     return DatasetSplits(train_x, train_y, test_x, test_y,
                          client_partitions=parts)
 
 
-# The exact 86-character TFF shakespeare vocabulary the reference uses
-# (federated_datasets.py:339) — char identity and order define token ids,
-# so this constant must match for model/data parity.
+# The 86-character TFF shakespeare vocabulary — char identity and order
+# define token ids, so this must match the reference's intent
+# (federated_datasets.py:339). Note the reference's literal is buggy:
+# `'...\r\{\}'` adds literal backslashes and braces for 90 raw entries
+# against its own 86-wide embedding (parameters.py:192); the true TFF
+# vocab is these 86 characters, unknown chars map to id 0.
 _SHAKESPEARE_CHARS = (
     "dhlptx@DHLPTX $(,048cgkoswCGKOSW[_#'/37;?bfjnrvzBFJNRVZ\"&*.26:"
-    "\naeimquyAEIMQUY]!%)-159\r{}"
+    "\naeimquyAEIMQUY]!%)-159\r"
 )
 
 
@@ -365,7 +371,7 @@ def load_adult(data_dir: str, sensitive_feature: int = 9,
     y_all = df["income"].str.contains(">50K").astype(np.int64)
     df = df.drop(columns=["income"])
     for col in df.columns:
-        if df[col].dtype == object:
+        if not pd.api.types.is_numeric_dtype(df[col]):
             df[col] = df[col].astype("category").cat.codes
     train_x = df.loc["train"].to_numpy(np.float32)
     test_x = df.loc["test"].to_numpy(np.float32)

@@ -54,13 +54,21 @@ def define_model(cfg: ExperimentConfig, batch_size: int = 2) -> ModelDef:
     arch = cfg.model.arch
     dataset = cfg.data.dataset
     m = cfg.model
+    if cfg.mesh.compute_dtype != "float32" \
+            and not arch.startswith("resnet"):
+        import warnings
+        warnings.warn(
+            f"compute_dtype={cfg.mesh.compute_dtype!r} is currently only "
+            f"wired into the resnet family; {arch!r} runs in float32",
+            stacklevel=2)
 
     if arch.startswith("wideresnet"):
         module = build_wideresnet(arch, dataset, m.wideresnet_widen_factor,
                                   m.drop_rate, m.norm)
         return ModelDef(arch, module, _sample_image(dataset, batch_size))
     if arch.startswith("resnet"):
-        module = build_resnet(arch, dataset, m.norm)
+        module = build_resnet(arch, dataset, m.norm,
+                              dtype=cfg.mesh.compute_dtype)
         return ModelDef(arch, module, _sample_image(dataset, batch_size))
     if arch.startswith("densenet"):
         module = build_densenet(arch, dataset, m.densenet_growth_rate,

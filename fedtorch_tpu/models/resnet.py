@@ -9,37 +9,48 @@
   from the dataset family (resnet.py:260-274).
 
 NHWC + configurable norm ('bn' = batch-stats norm, 'gn' = GroupNorm; see
-models/common.py for the rationale).
+models/common.py). ``dtype='bfloat16'`` runs convs/matmuls in bf16 on the
+MXU while keeping parameters and normalization statistics in float32.
 """
 from __future__ import annotations
 
-from typing import Sequence, Type
+from typing import Type
 
 import flax.linen as nn
+import jax.numpy as jnp
 
 from fedtorch_tpu.models.common import make_norm, num_classes_of
+
+
+def _norm32(kind: str, x, dtype):
+    """Normalize in float32 for stability, return in compute dtype."""
+    y = make_norm(kind)(x.astype(jnp.float32))
+    return y.astype(dtype)
 
 
 class BasicBlock(nn.Module):
     planes: int
     stride: int = 1
     norm: str = "bn"
+    dtype: str = "float32"
     expansion = 1
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        dt = jnp.dtype(self.dtype)
         residual = x
         y = nn.Conv(self.planes, (3, 3), strides=(self.stride, self.stride),
-                    padding=1, use_bias=False)(x)
-        y = make_norm(self.norm)(y)
+                    padding=1, use_bias=False, dtype=dt)(x)
+        y = _norm32(self.norm, y, dt)
         y = nn.relu(y)
-        y = nn.Conv(self.planes, (3, 3), padding=1, use_bias=False)(y)
-        y = make_norm(self.norm)(y)
+        y = nn.Conv(self.planes, (3, 3), padding=1, use_bias=False,
+                    dtype=dt)(y)
+        y = _norm32(self.norm, y, dt)
         if self.stride != 1 or x.shape[-1] != self.planes:
             residual = nn.Conv(self.planes, (1, 1),
                                strides=(self.stride, self.stride),
-                               use_bias=False)(x)
-            residual = make_norm(self.norm)(residual)
+                               use_bias=False, dtype=dt)(x)
+            residual = _norm32(self.norm, residual, dt)
         return nn.relu(y + residual)
 
 
@@ -47,26 +58,28 @@ class Bottleneck(nn.Module):
     planes: int
     stride: int = 1
     norm: str = "bn"
+    dtype: str = "float32"
     expansion = 4
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        dt = jnp.dtype(self.dtype)
         residual = x
         out_planes = self.planes * self.expansion
-        y = nn.Conv(self.planes, (1, 1), use_bias=False)(x)
-        y = make_norm(self.norm)(y)
+        y = nn.Conv(self.planes, (1, 1), use_bias=False, dtype=dt)(x)
+        y = _norm32(self.norm, y, dt)
         y = nn.relu(y)
         y = nn.Conv(self.planes, (3, 3), strides=(self.stride, self.stride),
-                    padding=1, use_bias=False)(y)
-        y = make_norm(self.norm)(y)
+                    padding=1, use_bias=False, dtype=dt)(y)
+        y = _norm32(self.norm, y, dt)
         y = nn.relu(y)
-        y = nn.Conv(out_planes, (1, 1), use_bias=False)(y)
-        y = make_norm(self.norm)(y)
+        y = nn.Conv(out_planes, (1, 1), use_bias=False, dtype=dt)(y)
+        y = _norm32(self.norm, y, dt)
         if self.stride != 1 or x.shape[-1] != out_planes:
             residual = nn.Conv(out_planes, (1, 1),
                                strides=(self.stride, self.stride),
-                               use_bias=False)(x)
-            residual = make_norm(self.norm)(residual)
+                               use_bias=False, dtype=dt)(x)
+            residual = _norm32(self.norm, residual, dt)
         return nn.relu(y + residual)
 
 
@@ -74,29 +87,35 @@ class ResNetCifar(nn.Module):
     dataset: str
     size: int
     norm: str = "bn"
+    dtype: str = "float32"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         if self.size % 6 != 2:
             raise ValueError(f"resnet_size must be 6n+2, got {self.size}")
+        dt = jnp.dtype(self.dtype)
+        x = x.astype(dt)
         n_blocks = (self.size - 2) // 6
         block: Type = Bottleneck if self.size >= 44 else BasicBlock
-        x = nn.Conv(16, (3, 3), padding=1, use_bias=False)(x)
-        x = make_norm(self.norm)(x)
+        x = nn.Conv(16, (3, 3), padding=1, use_bias=False, dtype=dt)(x)
+        x = _norm32(self.norm, x, dt)
         x = nn.relu(x)
         for stage, planes in enumerate((16, 32, 64)):
             for i in range(n_blocks):
                 stride = 2 if (stage > 0 and i == 0) else 1
-                x = block(planes=planes, stride=stride, norm=self.norm)(
-                    x, train=train)
+                x = block(planes=planes, stride=stride, norm=self.norm,
+                          dtype=self.dtype)(x, train=train)
         x = x.mean(axis=(1, 2))
-        return nn.Dense(num_classes_of(self.dataset))(x)
+        # classifier head in f32 for logit fidelity
+        return nn.Dense(num_classes_of(self.dataset))(
+            x.astype(jnp.float32))
 
 
 class ResNetImageNet(nn.Module):
     dataset: str
     size: int
     norm: str = "bn"
+    dtype: str = "float32"
 
     _PARAMS = {
         18: (BasicBlock, (2, 2, 2, 2)),
@@ -108,28 +127,35 @@ class ResNetImageNet(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        dt = jnp.dtype(self.dtype)
+        x = x.astype(dt)
         block, layers = self._PARAMS[self.size]
-        x = nn.Conv(64, (7, 7), strides=(2, 2), padding=3, use_bias=False)(x)
-        x = make_norm(self.norm)(x)
+        x = nn.Conv(64, (7, 7), strides=(2, 2), padding=3, use_bias=False,
+                    dtype=dt)(x)
+        x = _norm32(self.norm, x, dt)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
         for stage, (planes, n_blocks) in enumerate(
                 zip((64, 128, 256, 512), layers)):
             for i in range(n_blocks):
                 stride = 2 if (stage > 0 and i == 0) else 1
-                x = block(planes=planes, stride=stride, norm=self.norm)(
-                    x, train=train)
+                x = block(planes=planes, stride=stride, norm=self.norm,
+                          dtype=self.dtype)(x, train=train)
         x = x.mean(axis=(1, 2))
-        return nn.Dense(num_classes_of(self.dataset))(x)
+        return nn.Dense(num_classes_of(self.dataset))(
+            x.astype(jnp.float32))
 
 
-def build_resnet(arch: str, dataset: str, norm: str = "bn") -> nn.Module:
+def build_resnet(arch: str, dataset: str, norm: str = "bn",
+                 dtype: str = "float32") -> nn.Module:
     """Factory matching resnet.py:260-274 arch-string parsing."""
     size = int(arch.replace("resnet", ""))
     if "cifar" in dataset or "svhn" in dataset \
             or "downsampled_imagenet" in dataset or dataset == "stl10":
-        return ResNetCifar(dataset=dataset, size=size, norm=norm)
+        return ResNetCifar(dataset=dataset, size=size, norm=norm,
+                           dtype=dtype)
     if "imagenet" in dataset:
-        return ResNetImageNet(dataset=dataset, size=size, norm=norm)
+        return ResNetImageNet(dataset=dataset, size=size, norm=norm,
+                              dtype=dtype)
     raise NotImplementedError(
         f"resnet supports cifar/imagenet-family datasets, got {dataset!r}")

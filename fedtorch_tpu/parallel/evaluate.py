@@ -40,33 +40,43 @@ def _pad_batches(x: np.ndarray, y: np.ndarray, batch_size: int):
             mask.reshape(n_batches, batch_size))
 
 
+# jitted-callable caches keyed on the (hashable) flax module + flags, so
+# repeated evaluate() calls in the driver loop reuse one traced program
+# instead of re-tracing a fresh closure every round
+_ASCENT_CACHE = {}
+_EVAL_CACHE = {}
+
+
 def _ascent_on_batches(model: ModelDef, params, bx, by, bm,
                        step_size: float = 0.01):
     """Noise-ascent core over pre-padded batches (masked so padding rows
     contribute nothing to the ascent gradient)."""
     from fedtorch_tpu.core.losses import per_sample_loss
 
-    @jax.jit
-    def run(params, bx, by, bm):
-        def body(params, batch):
-            xb, yb, mb = batch
+    key = (model.module, model.is_regression, step_size)
+    if key not in _ASCENT_CACHE:
+        def run(params, bx, by, bm):
+            def body(params, batch):
+                xb, yb, mb = batch
 
-            def loss_fn(noise):
-                p = dict(params, noise=noise)
-                logits = model.apply(p, xb)
-                per = per_sample_loss(logits, yb, model.is_regression)
-                return jnp.sum(per * mb) / jnp.maximum(jnp.sum(mb), 1.0)
+                def loss_fn(noise):
+                    p = dict(params, noise=noise)
+                    logits = model.apply(p, xb)
+                    per = per_sample_loss(logits, yb, model.is_regression)
+                    return jnp.sum(per * mb) / jnp.maximum(jnp.sum(mb),
+                                                           1.0)
 
-            g = jax.grad(loss_fn)(params["noise"])
-            noise = params["noise"] + step_size * g
-            norm = jnp.linalg.norm(noise)
-            noise = jnp.where(norm > 1.0, noise / norm, noise)
-            return dict(params, noise=noise), None
+                g = jax.grad(loss_fn)(params["noise"])
+                noise = params["noise"] + step_size * g
+                norm = jnp.linalg.norm(noise)
+                noise = jnp.where(norm > 1.0, noise / norm, noise)
+                return dict(params, noise=noise), None
 
-        params, _ = jax.lax.scan(body, params, (bx, by, bm))
-        return params
+            params, _ = jax.lax.scan(body, params, (bx, by, bm))
+            return params
 
-    return run(params, bx, by, bm)
+        _ASCENT_CACHE[key] = jax.jit(run)
+    return _ASCENT_CACHE[key](params, bx, by, bm)
 
 
 def robust_noise_ascent(model: ModelDef, params, x: np.ndarray,
@@ -96,43 +106,46 @@ def evaluate(model: ModelDef, params, x: np.ndarray, y: np.ndarray,
         # pad/upload once; the ascent shares the same device batches
         params = _ascent_on_batches(model, params, bx, by, bm)
 
-    @jax.jit
-    def run(params, bx, by, bm):
-        def body(carry, batch):
-            xb, yb, mb = batch
-            if model.is_recurrent:
-                logits, _ = model.apply(params, xb,
-                                        carry=model.init_carry(xb.shape[0]))
-                # per-sample over the flattened time axis
-                logits = logits.reshape(-1, logits.shape[-1])
-                yb_f = yb.reshape(-1)
-                mb_f = jnp.repeat(mb, yb.shape[-1])
-            else:
-                logits = model.apply(params, xb)
-                yb_f, mb_f = yb, mb
-            # per-sample statistics masked so padding rows (duplicates of
-            # the head of the split) contribute nothing
-            if model.is_regression:
-                per = jnp.square(logits.reshape(-1) - yb_f)
-                t1 = t5 = jnp.zeros_like(per)
-            else:
-                logp = jax.nn.log_softmax(logits)
-                per = -jnp.take_along_axis(
-                    logp, yb_f[:, None].astype(jnp.int32), axis=-1)[:, 0]
-                kmax = min(5, logits.shape[-1])
-                _, pred = jax.lax.top_k(logits, kmax)
-                correct = pred == yb_f[:, None].astype(pred.dtype)
-                t1 = correct[:, 0].astype(jnp.float32)
-                t5 = jnp.any(correct, axis=1).astype(jnp.float32)
-            return carry, (jnp.sum(per * mb_f), jnp.sum(t1 * mb_f),
-                           jnp.sum(t5 * mb_f), jnp.sum(mb_f))
+    key = (model.module, model.is_regression, model.is_recurrent)
+    if key not in _EVAL_CACHE:
+        def run(params, bx, by, bm):
+            def body(carry, batch):
+                xb, yb, mb = batch
+                if model.is_recurrent:
+                    logits, _ = model.apply(
+                        params, xb, carry=model.init_carry(xb.shape[0]))
+                    # per-sample over the flattened time axis
+                    logits = logits.reshape(-1, logits.shape[-1])
+                    yb_f = yb.reshape(-1)
+                    mb_f = jnp.repeat(mb, yb.shape[-1])
+                else:
+                    logits = model.apply(params, xb)
+                    yb_f, mb_f = yb, mb
+                # per-sample statistics masked so padding rows (duplicates
+                # of the head of the split) contribute nothing
+                if model.is_regression:
+                    per = jnp.square(logits.reshape(-1) - yb_f)
+                    t1 = t5 = jnp.zeros_like(per)
+                else:
+                    logp = jax.nn.log_softmax(logits)
+                    per = -jnp.take_along_axis(
+                        logp, yb_f[:, None].astype(jnp.int32),
+                        axis=-1)[:, 0]
+                    kmax = min(5, logits.shape[-1])
+                    _, pred = jax.lax.top_k(logits, kmax)
+                    correct = pred == yb_f[:, None].astype(pred.dtype)
+                    t1 = correct[:, 0].astype(jnp.float32)
+                    t5 = jnp.any(correct, axis=1).astype(jnp.float32)
+                return carry, (jnp.sum(per * mb_f), jnp.sum(t1 * mb_f),
+                               jnp.sum(t5 * mb_f), jnp.sum(mb_f))
 
-        _, (losses, t1s, t5s, ws) = jax.lax.scan(body, 0, (bx, by, bm))
-        total = jnp.maximum(jnp.sum(ws), 1e-8)
-        return EvalResult(jnp.sum(losses) / total, jnp.sum(t1s) / total,
-                          jnp.sum(t5s) / total)
+            _, (losses, t1s, t5s, ws) = jax.lax.scan(body, 0, (bx, by, bm))
+            total = jnp.maximum(jnp.sum(ws), 1e-8)
+            return EvalResult(jnp.sum(losses) / total,
+                              jnp.sum(t1s) / total, jnp.sum(t5s) / total)
 
-    return run(params, jnp.asarray(bx), jnp.asarray(by), jnp.asarray(bm))
+        _EVAL_CACHE[key] = jax.jit(run)
+    return _EVAL_CACHE[key](params, bx, by, bm)
 
 
 def evaluate_clients(model: ModelDef, client_params, data,

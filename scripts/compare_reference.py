@@ -209,6 +209,10 @@ def main():
     for algo in args.algos:
         ref_wall = run_reference(algo, args.rounds)
         refm = ref_final_metrics(algo)
+        if not refm:
+            raise RuntimeError(
+                f"reference run for {algo!r} produced no parseable "
+                f"metrics — inspect {WORKDIR}/ref_{algo}.log")
         cx, cy, tx, ty = load_reference_data()
         ours_wall, tr, te = run_ours(algo, args.rounds, cx, cy, tx, ty,
                                      use_tpu=args.tpu)
