@@ -54,17 +54,19 @@ def define_model(cfg: ExperimentConfig, batch_size: int = 2) -> ModelDef:
     arch = cfg.model.arch
     dataset = cfg.data.dataset
     m = cfg.model
+    _DTYPE_ARCHES = ("resnet", "wideresnet", "densenet", "cnn", "mlp",
+                     "robust_mlp")
     if cfg.mesh.compute_dtype != "float32" \
-            and not arch.startswith("resnet"):
+            and not arch.startswith(_DTYPE_ARCHES):
         import warnings
         warnings.warn(
-            f"compute_dtype={cfg.mesh.compute_dtype!r} is currently only "
-            f"wired into the resnet family; {arch!r} runs in float32",
-            stacklevel=2)
+            f"compute_dtype={cfg.mesh.compute_dtype!r} is not wired into "
+            f"{arch!r}; it runs in float32", stacklevel=2)
 
     if arch.startswith("wideresnet"):
         module = build_wideresnet(arch, dataset, m.wideresnet_widen_factor,
-                                  m.drop_rate, m.norm)
+                                  m.drop_rate, m.norm,
+                                  dtype=cfg.mesh.compute_dtype)
         return ModelDef(arch, module, _sample_image(dataset, batch_size))
     if arch.startswith("resnet"):
         module = build_resnet(arch, dataset, m.norm,
@@ -73,7 +75,8 @@ def define_model(cfg: ExperimentConfig, batch_size: int = 2) -> ModelDef:
     if arch.startswith("densenet"):
         module = build_densenet(arch, dataset, m.densenet_growth_rate,
                                 m.densenet_bc_mode, m.densenet_compression,
-                                m.drop_rate, m.norm)
+                                m.drop_rate, m.norm,
+                                dtype=cfg.mesh.compute_dtype)
         return ModelDef(arch, module, _sample_image(dataset, batch_size))
     if arch == "logistic_regression":
         return ModelDef(arch, LogisticRegression(dataset=dataset),
@@ -95,16 +98,19 @@ def define_model(cfg: ExperimentConfig, batch_size: int = 2) -> ModelDef:
     if arch == "mlp":
         module = MLP(dataset=dataset, num_layers=m.mlp_num_layers,
                      hidden_size=m.mlp_hidden_size, drop_rate=m.drop_rate,
-                     norm=m.norm)
+                     norm=m.norm, dtype=cfg.mesh.compute_dtype)
         return ModelDef(arch, module, _sample_flat(dataset, batch_size, cfg.data.synthetic_dim))
     if arch == "robust_mlp":
         module = MLP(dataset=dataset, num_layers=m.mlp_num_layers,
                      hidden_size=m.mlp_hidden_size, drop_rate=m.drop_rate,
-                     norm=m.norm, robust=True)
+                     norm=m.norm, robust=True,
+                     dtype=cfg.mesh.compute_dtype)
         return ModelDef(arch, module, _sample_flat(dataset, batch_size, cfg.data.synthetic_dim),
                         has_noise_param=True)
     if arch == "cnn":
-        return ModelDef(arch, CNN(dataset=dataset),
+        return ModelDef(arch,
+                        CNN(dataset=dataset,
+                            dtype=cfg.mesh.compute_dtype),
                         _sample_image(dataset, batch_size))
     if arch == "rnn":
         module = CharGRU(vocab_size=m.vocab_size,

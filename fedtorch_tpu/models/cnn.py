@@ -8,21 +8,26 @@ cnn.py:45-52 (4*4*50 mnist / 5*5*50 cifar).
 from __future__ import annotations
 
 import flax.linen as nn
+import jax.numpy as jnp
 
 from fedtorch_tpu.models.common import num_classes_of
 
 
 class CNN(nn.Module):
     dataset: str
+    dtype: str = "float32"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        x = nn.Conv(20, (5, 5), padding="VALID")(x)
+        dt = jnp.dtype(self.dtype)
+        x = x.astype(dt)
+        x = nn.Conv(20, (5, 5), padding="VALID", dtype=dt)(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
-        x = nn.Conv(50, (5, 5), padding="VALID")(x)
+        x = nn.Conv(50, (5, 5), padding="VALID", dtype=dt)(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = x.reshape((x.shape[0], -1))
-        x = nn.relu(nn.Dense(512)(x))
-        return nn.Dense(num_classes_of(self.dataset))(x)
+        x = nn.relu(nn.Dense(512, dtype=dt)(x))
+        return nn.Dense(num_classes_of(self.dataset))(
+            x.astype(jnp.float32))

@@ -108,6 +108,12 @@ class BatchStatsNorm(nn.Module):
         return y * scale + bias
 
 
+def norm_f32(kind: str, x, dtype):
+    """Normalize in float32 for stability, return in the compute dtype
+    (shared mixed-precision norm policy for the conv/dense zoo)."""
+    return make_norm(kind)(x.astype(jnp.float32)).astype(dtype)
+
+
 def make_norm(kind: str):
     """Norm factory: 'bn' -> batch-stats norm, 'gn' -> GroupNorm."""
     if kind == "bn":

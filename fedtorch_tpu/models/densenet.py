@@ -10,7 +10,8 @@ from __future__ import annotations
 import flax.linen as nn
 import jax.numpy as jnp
 
-from fedtorch_tpu.models.common import make_norm, num_classes_of
+from fedtorch_tpu.models.common import make_norm, norm_f32, \
+    num_classes_of
 
 
 class _DenseLayer(nn.Module):
@@ -18,16 +19,20 @@ class _DenseLayer(nn.Module):
     bc_mode: bool
     drop_rate: float = 0.0
     norm: str = "bn"
+    dtype: str = "float32"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        y = nn.relu(make_norm(self.norm)(x))
+        dt = jnp.dtype(self.dtype)
+        y = nn.relu(norm_f32(self.norm, x, dt))
         if self.bc_mode:
-            y = nn.Conv(4 * self.growth_rate, (1, 1), use_bias=False)(y)
-            y = nn.relu(make_norm(self.norm)(y))
-        y = nn.Conv(self.growth_rate, (3, 3), padding=1, use_bias=False)(y)
+            y = nn.Conv(4 * self.growth_rate, (1, 1), use_bias=False,
+                        dtype=dt)(y)
+            y = nn.relu(norm_f32(self.norm, y, dt))
+        y = nn.Conv(self.growth_rate, (3, 3), padding=1, use_bias=False,
+                    dtype=dt)(y)
         y = nn.Dropout(rate=self.drop_rate, deterministic=not train)(y)
-        return jnp.concatenate([x, y], axis=-1)
+        return jnp.concatenate([x.astype(dt), y], axis=-1)
 
 
 class DenseNet(nn.Module):
@@ -38,36 +43,39 @@ class DenseNet(nn.Module):
     compression: float = 1.0
     drop_rate: float = 0.0
     norm: str = "bn"
+    dtype: str = "float32"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        dt = jnp.dtype(self.dtype)
         layers_per_block = (self.depth - 4) // 3
         if self.bc_mode:
             layers_per_block //= 2
         ch = 2 * self.growth_rate if self.bc_mode else 16
-        x = nn.Conv(ch, (3, 3), padding=1, use_bias=False)(x)
+        x = nn.Conv(ch, (3, 3), padding=1, use_bias=False,
+                    dtype=dt)(x.astype(dt))
         for block in range(3):
             for _ in range(layers_per_block):
                 x = _DenseLayer(growth_rate=self.growth_rate,
                                 bc_mode=self.bc_mode,
-                                drop_rate=self.drop_rate, norm=self.norm)(
-                    x, train=train)
+                                drop_rate=self.drop_rate, norm=self.norm,
+                                dtype=self.dtype)(x, train=train)
             if block < 2:
                 out_ch = int(x.shape[-1] * self.compression)
-                x = nn.relu(make_norm(self.norm)(x))
-                x = nn.Conv(out_ch, (1, 1), use_bias=False)(x)
+                x = nn.relu(norm_f32(self.norm, x, dt))
+                x = nn.Conv(out_ch, (1, 1), use_bias=False, dtype=dt)(x)
                 x = nn.avg_pool(x, (2, 2), strides=(2, 2))
-        x = nn.relu(make_norm(self.norm)(x))
+        x = nn.relu(make_norm(self.norm)(x.astype(jnp.float32)))
         x = x.mean(axis=(1, 2))
         return nn.Dense(num_classes_of(self.dataset))(x)
 
 
 def build_densenet(arch: str, dataset: str, growth_rate: int, bc_mode: bool,
                    compression: float, drop_rate: float,
-                   norm: str = "bn") -> nn.Module:
+                   norm: str = "bn", dtype: str = "float32") -> nn.Module:
     """arch string 'densenet<depth>' (factory densenet.py:200-208)."""
     depth = int(arch.replace("densenet", ""))
     return DenseNet(dataset=dataset, depth=depth, growth_rate=growth_rate,
                     bc_mode=bc_mode,
                     compression=compression if bc_mode else 1.0,
-                    drop_rate=drop_rate, norm=norm)
+                    drop_rate=drop_rate, norm=norm, dtype=dtype)

@@ -7,9 +7,10 @@ learnable input-noise parameter to the flattened input (robust_mlp.py:54).
 from __future__ import annotations
 
 import flax.linen as nn
+import jax.numpy as jnp
 
 from fedtorch_tpu.models.common import (
-    BatchStatsNorm, flat_input_size, make_norm, num_classes_of,
+    BatchStatsNorm, flat_input_size, make_norm, norm_f32, num_classes_of,
 )
 from fedtorch_tpu.models.linear import _noise_init
 
@@ -21,18 +22,21 @@ class MLP(nn.Module):
     drop_rate: float = 0.0
     robust: bool = False
     norm: str = "bn"
+    dtype: str = "float32"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        dt = jnp.dtype(self.dtype)
         x = x.reshape((x.shape[0], -1))
         if self.robust:
             noise = self.param("noise", _noise_init(),
                                (flat_input_size(self.dataset),))
             x = x + noise
         for i in range(self.num_layers):
-            x = nn.Dense(self.hidden_size, name=f"layer{i + 1}")(x)
-            x = make_norm(self.norm)(x)
+            x = nn.Dense(self.hidden_size, name=f"layer{i + 1}",
+                         dtype=dt)(x.astype(dt))
+            x = norm_f32(self.norm, x, dt)
             x = nn.relu(x)
             x = nn.Dropout(rate=self.drop_rate, deterministic=not train)(x)
         return nn.Dense(num_classes_of(self.dataset), use_bias=False,
-                        name="fc")(x)
+                        name="fc")(x.astype(jnp.float32))

@@ -19,13 +19,8 @@ from typing import Type
 import flax.linen as nn
 import jax.numpy as jnp
 
-from fedtorch_tpu.models.common import make_norm, num_classes_of
-
-
-def _norm32(kind: str, x, dtype):
-    """Normalize in float32 for stability, return in compute dtype."""
-    y = make_norm(kind)(x.astype(jnp.float32))
-    return y.astype(dtype)
+from fedtorch_tpu.models.common import norm_f32 as _norm32, \
+    num_classes_of
 
 
 class BasicBlock(nn.Module):

@@ -7,8 +7,10 @@ dropout between the convolutions, global average pool + linear head.
 from __future__ import annotations
 
 import flax.linen as nn
+import jax.numpy as jnp
 
-from fedtorch_tpu.models.common import make_norm, num_classes_of
+from fedtorch_tpu.models.common import make_norm, norm_f32, \
+    num_classes_of
 
 
 class _WideBasic(nn.Module):
@@ -16,26 +18,29 @@ class _WideBasic(nn.Module):
     stride: int = 1
     drop_rate: float = 0.0
     norm: str = "bn"
+    dtype: str = "float32"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        y = make_norm(self.norm)(x)
+        dt = jnp.dtype(self.dtype)
+        y = norm_f32(self.norm, x, dt)
         y = nn.relu(y)
         shortcut_src = y if (self.stride != 1
                              or x.shape[-1] != self.planes) else x
         y = nn.Conv(self.planes, (3, 3), strides=(self.stride, self.stride),
-                    padding=1, use_bias=False)(y)
-        y = make_norm(self.norm)(y)
+                    padding=1, use_bias=False, dtype=dt)(y)
+        y = norm_f32(self.norm, y, dt)
         y = nn.relu(y)
         y = nn.Dropout(rate=self.drop_rate, deterministic=not train)(y)
-        y = nn.Conv(self.planes, (3, 3), padding=1, use_bias=False)(y)
+        y = nn.Conv(self.planes, (3, 3), padding=1, use_bias=False,
+                    dtype=dt)(y)
         if self.stride != 1 or x.shape[-1] != self.planes:
             shortcut = nn.Conv(self.planes, (1, 1),
                                strides=(self.stride, self.stride),
-                               use_bias=False)(shortcut_src)
+                               use_bias=False, dtype=dt)(shortcut_src)
         else:
             shortcut = x
-        return y + shortcut
+        return y + shortcut.astype(dt)
 
 
 class WideResNet(nn.Module):
@@ -44,29 +49,33 @@ class WideResNet(nn.Module):
     widen_factor: int = 4
     drop_rate: float = 0.0
     norm: str = "bn"
+    dtype: str = "float32"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         if (self.depth - 4) % 6 != 0:
             raise ValueError("wideresnet depth must be 6n+4")
+        dt = jnp.dtype(self.dtype)
         n = (self.depth - 4) // 6
         k = self.widen_factor
-        x = nn.Conv(16, (3, 3), padding=1, use_bias=False)(x)
+        x = nn.Conv(16, (3, 3), padding=1, use_bias=False,
+                    dtype=dt)(x.astype(dt))
         for stage, planes in enumerate((16 * k, 32 * k, 64 * k)):
             for i in range(n):
                 stride = 2 if (stage > 0 and i == 0) else 1
                 x = _WideBasic(planes=planes, stride=stride,
-                               drop_rate=self.drop_rate, norm=self.norm)(
-                    x, train=train)
-        x = nn.relu(make_norm(self.norm)(x))
+                               drop_rate=self.drop_rate, norm=self.norm,
+                               dtype=self.dtype)(x, train=train)
+        x = nn.relu(make_norm(self.norm)(x.astype(jnp.float32)))
         x = x.mean(axis=(1, 2))
         return nn.Dense(num_classes_of(self.dataset))(x)
 
 
 def build_wideresnet(arch: str, dataset: str, widen_factor: int,
-                     drop_rate: float, norm: str = "bn") -> nn.Module:
+                     drop_rate: float, norm: str = "bn",
+                     dtype: str = "float32") -> nn.Module:
     """arch string 'wideresnet<depth>' (factory wideresnet.py:135-144)."""
     depth = int(arch.replace("wideresnet", ""))
     return WideResNet(dataset=dataset, depth=depth,
                       widen_factor=widen_factor, drop_rate=drop_rate,
-                      norm=norm)
+                      norm=norm, dtype=dtype)
