@@ -61,21 +61,53 @@ class LocalSGDTrainer(FederatedTrainer):
         self.steps_schedule = local_steps_from_config(cfg)
         self._round_cache = {}
         self._raw_splits = raw_splits  # for reshuffle_per_epoch
+        # growing minibatch mode (GrowingMinibatchSampler,
+        # dataset.py:276-317): per-step batch sizes grow geometrically;
+        # bucketed to powers of two so recompiles stay O(log(max/base))
+        self._batch_schedule = None
+        if cfg.data.growing_batch_size:
+            from fedtorch_tpu.data.batching import growing_batch_schedule
+            iteration_mode = (cfg.train.stop_criteria == "iteration"
+                              and cfg.train.num_iterations is not None)
+            self._batch_schedule = growing_batch_schedule(
+                base_batch_size=cfg.data.base_batch_size or 2,
+                max_batch_size=cfg.data.max_batch_size,
+                num_samples_per_epoch=int(data.sizes.sum()),
+                num_epochs=None if iteration_mode
+                else (cfg.train.num_epochs or 1),
+                num_iterations=cfg.train.num_iterations
+                if iteration_mode else None)
 
-    def _round_with_steps(self, K: int):
-        if K not in self._round_cache:
+    def _bucketed_batch(self, step: int) -> int:
+        """Power-of-two bucket of the scheduled batch size, never above
+        max_batch_size (the capped schedule's tail ends with a one-time
+        remainder batch — runs outliving the schedule sustain the peak
+        size instead of that remainder)."""
+        sched = self._batch_schedule
+        b = sched[step] if step < len(sched) else max(sched)
+        p = 1
+        while p < b:
+            p *= 2
+        cap = self.cfg.data.max_batch_size or p
+        return max(min(p, cap, max(int(self.data.n_max), 1)), 1)
+
+    def _round_with_steps(self, K: int, B: int = None):
+        key = (K, B)
+        if key not in self._round_cache:
             def fn(server, clients, data, val_data):
-                old = self.local_steps
-                old_alg = self.algorithm.local_steps_per_round
+                old = (self.local_steps, self.batch_size,
+                       self.algorithm.local_steps_per_round)
                 self.local_steps = K
                 self.algorithm.local_steps_per_round = K
+                if B is not None:
+                    self.batch_size = B
                 try:
                     return self.round_fn(server, clients, data, val_data)
                 finally:
-                    self.local_steps = old
-                    self.algorithm.local_steps_per_round = old_alg
-            self._round_cache[K] = jax.jit(fn, donate_argnums=(0, 1))
-        return self._round_cache[K]
+                    (self.local_steps, self.batch_size,
+                     self.algorithm.local_steps_per_round) = old
+            self._round_cache[key] = jax.jit(fn, donate_argnums=(0, 1))
+        return self._round_cache[key]
 
     def _reshuffle(self, epoch_seed: int):
         """reshuffle_per_epoch: re-partition across workers
@@ -110,7 +142,8 @@ class LocalSGDTrainer(FederatedTrainer):
                 last_epoch_int = int(epoch)
                 self._reshuffle(cfg.train.manual_seed + last_epoch_int)
             K = max(self.steps_schedule[epoch_idx], 1)
-            server, clients, metrics = self._round_with_steps(K)(
+            B = self._bucketed_batch(it) if self._batch_schedule else None
+            server, clients, metrics = self._round_with_steps(K, B)(
                 server, clients, self.data, self.val_data)
             if callback is not None:
                 callback(server, clients, metrics)

@@ -68,6 +68,46 @@ def test_warmup_schedule_varies_round_length():
     assert len(trainer._round_cache) >= 2  # two distinct K compiled
 
 
+def test_growing_batch_mode():
+    """Growing minibatch (dataset.py:276-317): batch size grows over the
+    run, bucketed to powers of two for compile caching."""
+    import dataclasses
+    from fedtorch_tpu.config import DataConfig
+    trainer, (tx, ty) = _setup(num_epochs=2, local_step=2)
+    cfg = dataclasses.replace(
+        trainer.cfg, data=dataclasses.replace(
+            trainer.cfg.data, growing_batch_size=True, base_batch_size=4,
+            max_batch_size=64))
+    from fedtorch_tpu.parallel.local_sgd import LocalSGDTrainer
+    import numpy as np
+    feats = np.asarray(trainer.data.x).reshape(-1, 16)
+    labels = np.asarray(trainer.data.y).reshape(-1)
+    from fedtorch_tpu.parallel import build_local_sgd
+    from fedtorch_tpu.models import define_model
+    model = define_model(cfg, batch_size=4)
+    t2 = build_local_sgd(cfg, model, feats, labels)
+    assert t2._batch_schedule is not None
+    assert t2._batch_schedule[0] == 5  # int(4*1.01^0)+1
+    server, clients, history = t2.fit(jax.random.key(5))
+    assert len(history) > 0
+    # rounds ran with a schedule-derived (non-None) batch bucket
+    batch_keys = {k[1] for k in t2._round_cache}
+    assert batch_keys and None not in batch_keys, batch_keys
+    # the bucketing mechanism crosses powers of two as steps grow
+    # (rho=1.01: int(4*1.01^i)+1 crosses 8 around step 70, 16 ~ step 139)
+    assert t2._bucketed_batch(0) == 8
+    assert t2._bucketed_batch(80) == 16
+    # past the schedule end the PEAK size is sustained (not the one-time
+    # remainder tail batch), still respecting the cap
+    assert t2._bucketed_batch(10_000) == 64
+    # a non-power-of-two cap is never exceeded
+    cfg48 = dataclasses.replace(
+        cfg, data=dataclasses.replace(cfg.data, max_batch_size=48))
+    t3 = build_local_sgd(cfg48, define_model(cfg48, batch_size=4),
+                         feats, labels)
+    assert all(t3._bucketed_batch(s) <= 48 for s in (0, 100, 10_000))
+
+
 def test_sum_mode_changes_magnitude():
     t_avg, _ = _setup(avg_model=True, num_epochs=1, local_step=2)
     t_sum, _ = _setup(avg_model=False, num_epochs=1, local_step=2)
