@@ -144,6 +144,9 @@ def growing_batch_schedule(base_batch_size: int = 2,
             overflow = int(np.sum(b[over]))
             batch_sizes = batch_sizes[:over[0]] \
                 + [max_batch_size] * (overflow // max_batch_size)
-            if overflow // max_batch_size:
+            if overflow % max_batch_size:
+                # the reference appends the remainder even when zero
+                # (dataset.py:300-307) — an empty batch its loader skips;
+                # we omit the no-op entry
                 batch_sizes += [overflow % max_batch_size]
     return batch_sizes

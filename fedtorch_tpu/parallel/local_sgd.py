@@ -70,9 +70,13 @@ class LocalSGDTrainer(FederatedTrainer):
             iteration_mode = (cfg.train.stop_criteria == "iteration"
                               and cfg.train.num_iterations is not None)
             self._batch_schedule = growing_batch_schedule(
-                base_batch_size=cfg.data.base_batch_size or 2,
+                # reference default base is 1 (parameters.py:243-244,
+                # normalized in config.finalize)
+                base_batch_size=cfg.data.base_batch_size or 1,
                 max_batch_size=cfg.data.max_batch_size,
-                num_samples_per_epoch=int(data.sizes.sum()),
+                # the reference builds the sampler over each RANK's shard
+                # (dataset.py:144-151), not the global sample count
+                num_samples_per_epoch=int(data.sizes.mean()),
                 num_epochs=None if iteration_mode
                 else (cfg.train.num_epochs or 1),
                 num_iterations=cfg.train.num_iterations

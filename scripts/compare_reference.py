@@ -88,6 +88,8 @@ def reference_argv(algo: str, rounds: int, extra=()):
     ]
     if algo == "drfa":
         argv += ["--federated_drfa", "True", "--drfa_gamma", "0.1"]
+    if algo == "apfl":
+        argv += ["--fed_personal", "True", "--fed_personal_alpha", "0.5"]
     return argv + list(extra)
 
 

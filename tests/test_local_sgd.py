@@ -93,19 +93,28 @@ def test_growing_batch_mode():
     # rounds ran with a schedule-derived (non-None) batch bucket
     batch_keys = {k[1] for k in t2._round_cache}
     assert batch_keys and None not in batch_keys, batch_keys
-    # the bucketing mechanism crosses powers of two as steps grow
-    # (rho=1.01: int(4*1.01^i)+1 crosses 8 around step 70, 16 ~ step 139)
-    assert t2._bucketed_batch(0) == 8
-    assert t2._bucketed_batch(80) == 16
-    # past the schedule end the PEAK size is sustained (not the one-time
-    # remainder tail batch), still respecting the cap
-    assert t2._bucketed_batch(10_000) == 64
+    assert t2._bucketed_batch(0) == 8  # int(4*1.01^0)+1 = 5 -> pow2 8
+
+    # longer run (more epochs -> longer per-worker schedule) crosses
+    # power-of-two buckets and sustains the peak past the schedule end
+    cfg40 = dataclasses.replace(
+        cfg, train=dataclasses.replace(cfg.train, num_epochs=40))
+    t3 = build_local_sgd(cfg40, define_model(cfg40, batch_size=4),
+                         feats, labels)
+    sched = t3._batch_schedule
+    assert len(sched) > 100
+    assert t3._bucketed_batch(len(sched) // 2) >= 8
+    # past the end: peak (not a remainder tail batch), capped at 64
+    assert t3._bucketed_batch(10_000) == \
+        min(64, 1 << (max(sched) - 1).bit_length())
     # a non-power-of-two cap is never exceeded
     cfg48 = dataclasses.replace(
-        cfg, data=dataclasses.replace(cfg.data, max_batch_size=48))
-    t3 = build_local_sgd(cfg48, define_model(cfg48, batch_size=4),
+        cfg40, data=dataclasses.replace(cfg40.data, max_batch_size=48))
+    t4 = build_local_sgd(cfg48, define_model(cfg48, batch_size=4),
                          feats, labels)
-    assert all(t3._bucketed_batch(s) <= 48 for s in (0, 100, 10_000))
+    assert all(t4._bucketed_batch(s) <= 48 for s in (0, 100, 10_000))
+    # no zero entries in a capped schedule (a 0 would mean a B=1 round)
+    assert min(t4._batch_schedule) >= 1
 
 
 def test_sum_mode_changes_magnitude():
