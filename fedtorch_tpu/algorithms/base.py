@@ -104,6 +104,8 @@ class FedAlgorithm:
         """Once per round, on the gathered [k] online-client aux, OUTSIDE
         the vmapped local loop — the place for cross-client work like
         APFL's globally-averaged adaptive alpha (apfl.py:119-123).
+        ``x``/``y``: each online client's first batch (first B
+        storage-order rows, identical in every gather mode);
         ``lr``: [k] scheduled LR at each online client's current epoch."""
         return on_aux
 

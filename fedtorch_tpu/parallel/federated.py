@@ -72,7 +72,8 @@ class FederatedTrainer:
 
     def __init__(self, cfg: ExperimentConfig, model: ModelDef,
                  algorithm: FedAlgorithm, data: ClientData,
-                 val_data: Optional[ClientData] = None, mesh=None):
+                 val_data: Optional[ClientData] = None, mesh=None,
+                 gather_mode: str = "auto"):
         self.cfg = cfg
         self.model = model
         self.algorithm = algorithm
@@ -82,7 +83,6 @@ class FederatedTrainer:
             raise ValueError(
                 f"{algorithm.name} needs per-client validation batches; "
                 "pass FederatedData.val (cfg.federated.personal builds it)")
-
         # static online-client count (online_client_rate, misc.py:14)
         self.k_online = max(
             int(cfg.federated.online_client_rate * self.num_clients), 1)
@@ -95,6 +95,25 @@ class FederatedTrainer:
             self.local_steps = nb_max * cfg.federated.num_epochs_per_comm
         else:
             self.local_steps = max(cfg.train.local_step, 1)
+
+        # 'batch' gathers only the K*B rows each online client will touch
+        # this round (bounds cross-device movement when K*B < shard
+        # size); 'shard' moves whole client shards and indexes per step —
+        # required when the algorithm reads the full local dataset (qFFL's
+        # full loss) and cheaper when a round revisits the shard (K*B >=
+        # n_max, e.g. epoch-sync with several epochs per round).
+        if gather_mode not in ("auto", "shard", "batch"):
+            raise ValueError(f"unknown gather_mode {gather_mode!r}")
+        if gather_mode == "auto":
+            gather_mode = "shard" if (
+                algorithm.needs_full_loss
+                or self.local_steps * self.batch_size >= data.n_max) \
+                else "batch"
+        if gather_mode == "batch" and algorithm.needs_full_loss:
+            raise ValueError(
+                f"{algorithm.name} requires gather_mode='shard' "
+                "(it evaluates the full local dataset each round)")
+        self.gather_mode = gather_mode
 
         num_epochs = cfg.train.num_epochs or 1
         self.schedule: LRSchedule = compile_schedule(
@@ -156,13 +175,45 @@ class FederatedTrainer:
         # gather online-client state & data rows (the per-round new_group)
         take = lambda t: jax.tree.map(lambda x: jnp.take(x, idx, axis=0), t)
         on_clients = take(clients)
-        on_x, on_y = jnp.take(data.x, idx, axis=0), \
-            jnp.take(data.y, idx, axis=0)
         on_sizes = jnp.take(data.sizes, idx)
+        rngs = jax.random.split(rng_train, self.k_online)
+        batch_mode = self.gather_mode == "batch"
+
+        def round_rows(rng_c, size, n_max, fold):
+            """The round's row plan: perm[(step*B + j) % size] for all
+            K*B (step, j) pairs — the epoch_permutation/take_batch batch
+            order (fold 0 = train stream, 7 = val stream)."""
+            perm = epoch_permutation(jax.random.fold_in(rng_c, fold), size,
+                                     n_max)
+            return perm[jnp.arange(K * B) % jnp.maximum(size, 1)]
+
+        if batch_mode:
+            # move only the touched rows: [k, K*B, ...]
+            rows = jax.vmap(lambda r, s: round_rows(
+                r, s, data.x.shape[1], 0))(rngs, on_sizes)
+            on_x = data.x[idx[:, None], rows]
+            on_y = data.y[idx[:, None], rows]
+        else:
+            # whole shards; rows are selected per step inside the vmap so
+            # nothing larger than the shard is ever materialized
+            on_x = jnp.take(data.x, idx, axis=0)
+            on_y = jnp.take(data.y, idx, axis=0)
+
+        # the val stream makes its own shard-vs-rows decision: val shards
+        # are typically much smaller than train shards, so K*B rows can
+        # exceed the shard itself
+        val_batch_mode = (batch_mode and val_data is not None
+                          and K * B < val_data.x.shape[1])
         if val_data is not None:
-            on_vx = jnp.take(val_data.x, idx, axis=0)
-            on_vy = jnp.take(val_data.y, idx, axis=0)
             on_vsizes = jnp.take(val_data.sizes, idx)
+            if val_batch_mode:
+                vrows = jax.vmap(lambda r, s: round_rows(
+                    r, s, val_data.x.shape[1], 7))(rngs, on_vsizes)
+                on_vx = val_data.x[idx[:, None], vrows]
+                on_vy = val_data.y[idx[:, None], vrows]
+            else:
+                on_vx = jnp.take(val_data.x, idx, axis=0)
+                on_vy = jnp.take(val_data.y, idx, axis=0)
         else:
             # unused placeholders keep the vmapped signature static
             on_vx, on_vy = on_x[:, :1], on_y[:, :1]
@@ -171,16 +222,22 @@ class FederatedTrainer:
         # cross-client pre-round hook (APFL adaptive alpha, apfl.py:119-123)
         on_lrs = jax.vmap(lambda e: lr_at(self.schedule, e))(
             on_clients.epoch)
-        on_aux0 = alg.pre_round(on_clients.aux, server=server, x=on_x,
-                                y=on_y, sizes=on_sizes, lr=on_lrs,
+        # the hook always sees each client's first B storage-order rows,
+        # independent of gather mode (so mode choice cannot change hook
+        # numerics, e.g. APFL's adaptive alpha)
+        pre_x = data.x[idx[:, None], jnp.arange(B)[None, :]]
+        pre_y = data.y[idx[:, None], jnp.arange(B)[None, :]]
+        on_aux0 = alg.pre_round(on_clients.aux, server=server, x=pre_x,
+                                y=pre_y, sizes=on_sizes, lr=on_lrs,
                                 rng=rng_round)
         on_clients = on_clients._replace(aux=on_aux0)
 
         def client_round(cstate: ClientState, x, y, vx, vy, size, vsize,
                          weight, rng_c):
+            # batch mode: x/y are the round's pre-selected rows [K*B, ...]
+            # shard mode: x/y are whole shards [n_max, ...], rows picked
+            # per step (nothing larger than the shard is materialized)
             nb = jnp.ceil(size / B)  # batches per local epoch
-            perm = epoch_permutation(jax.random.fold_in(rng_c, 0), size,
-                                     x.shape[0])
             server_params = server.params
             carry0 = model.init_carry(B)
 
@@ -189,13 +246,14 @@ class FederatedTrainer:
                 # qFFL: F_k = SUM of per-batch mean losses over the
                 # client's full data on the incoming server model
                 # (centered/main.py:62-72 accumulates loss.item() per
-                # batch — the sum scales with the client's batch count)
+                # batch — the sum scales with the client's batch count);
+                # shard mode is enforced so x IS the whole shard here
                 n_full = -(-x.shape[0] // B)
 
                 def floss(carry, i):
-                    rows = i * B + jnp.arange(B)
-                    m = (rows < size).astype(jnp.float32)
-                    xb, yb = x[rows % x.shape[0]], y[rows % x.shape[0]]
+                    frows = i * B + jnp.arange(B)
+                    m = (frows < size).astype(jnp.float32)
+                    xb, yb = x[frows % x.shape[0]], y[frows % x.shape[0]]
                     if model.is_recurrent:
                         logits, _ = model.apply(server_params, xb,
                                                 carry=carry0)
@@ -210,15 +268,28 @@ class FederatedTrainer:
                 _, batch_means = jax.lax.scan(floss, 0, jnp.arange(n_full))
                 full_loss = jnp.sum(batch_means)
 
-            vperm = epoch_permutation(jax.random.fold_in(rng_c, 7), vsize,
-                                      vx.shape[0])
+            if not batch_mode:
+                perm = epoch_permutation(jax.random.fold_in(rng_c, 0),
+                                         size, x.shape[0])
+            if alg.needs_val_batch and not val_batch_mode:
+                vperm = epoch_permutation(jax.random.fold_in(rng_c, 7),
+                                          vsize, vx.shape[0])
 
             def step(carry, k):
                 params, opt, aux, epoch, li, rnn_carry = carry
                 lr = lr_at(self.schedule, epoch)
-                bx, by = take_batch(x, y, perm, size, k, B)
+                if batch_mode:
+                    bx = jax.lax.dynamic_slice_in_dim(x, k * B, B)
+                    by = jax.lax.dynamic_slice_in_dim(y, k * B, B)
+                else:
+                    bx, by = take_batch(x, y, perm, size, k, B)
                 if alg.needs_val_batch:
-                    bval_x, bval_y = take_batch(vx, vy, vperm, vsize, k, B)
+                    if val_batch_mode:
+                        bval_x = jax.lax.dynamic_slice_in_dim(vx, k * B, B)
+                        bval_y = jax.lax.dynamic_slice_in_dim(vy, k * B, B)
+                    else:
+                        bval_x, bval_y = take_batch(vx, vy, vperm, vsize,
+                                                    k, B)
                 else:
                     bval_x = bval_y = None
                 drop_rng = jax.random.fold_in(rng_c, k + 1)
@@ -248,7 +319,6 @@ class FederatedTrainer:
             return payload, delta, new_state, (jnp.mean(losses),
                                                jnp.mean(accs))
 
-        rngs = jax.random.split(rng_train, self.k_online)
         payloads, deltas, new_on_clients, (losses, accs) = jax.vmap(
             client_round)(on_clients, on_x, on_y, on_vx, on_vy, on_sizes,
                           on_vsizes, weights, rngs)

@@ -1,0 +1,94 @@
+"""Gather-mode equivalence: 'batch' (move only the touched K*B rows) must
+produce bit-identical training to 'shard' (move whole client shards)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from fedtorch_tpu.algorithms import make_algorithm
+from fedtorch_tpu.config import (
+    DataConfig, ExperimentConfig, FederatedConfig, ModelConfig, OptimConfig,
+    TrainConfig,
+)
+from fedtorch_tpu.data import build_federated_data
+from fedtorch_tpu.models import define_model
+from fedtorch_tpu.parallel import FederatedTrainer
+
+
+def _build(gather_mode, algorithm="fedavg", **fed_kw):
+    cfg = ExperimentConfig(
+        data=DataConfig(dataset="synthetic", synthetic_dim=20,
+                        batch_size=16, synthetic_alpha=0.5,
+                        synthetic_beta=0.5),
+        federated=FederatedConfig(federated=True, num_clients=8,
+                                  online_client_rate=0.5,
+                                  algorithm=algorithm,
+                                  sync_type="local_step", **fed_kw),
+        model=ModelConfig(arch="logistic_regression"),
+        optim=OptimConfig(lr=0.3, weight_decay=0.0),
+        train=TrainConfig(local_step=5),
+    ).finalize()
+    data = build_federated_data(cfg)
+    model = define_model(cfg, batch_size=16)
+    return FederatedTrainer(cfg, model, make_algorithm(cfg), data.train,
+                            val_data=data.val, gather_mode=gather_mode)
+
+
+@pytest.mark.parametrize("algorithm,kw", [
+    ("fedavg", {}),
+    ("scaffold", {}),
+    ("fedgate", {"compressed": True, "compressed_ratio": 1.0}),
+    ("apfl", {}),
+    ("apfl", {"adaptive_alpha": True}),  # pre_round hook equivalence
+    ("perfedavg", {}),                   # val-stream equivalence
+])
+def test_batch_equals_shard(algorithm, kw):
+    t_shard = _build("shard", algorithm, **kw)
+    t_batch = _build("batch", algorithm, **kw)
+    assert t_shard.gather_mode == "shard"
+    assert t_batch.gather_mode == "batch"
+    s1, c1 = t_shard.init_state(jax.random.key(3))
+    s2, c2 = t_batch.init_state(jax.random.key(3))
+    for _ in range(3):
+        s1, c1, m1 = t_shard.run_round(s1, c1)
+        s2, c2, m2 = t_batch.run_round(s2, c2)
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(np.asarray(m1.train_loss),
+                                  np.asarray(m2.train_loss))
+
+
+def test_auto_resolves_batch_default():
+    t = _build("auto")
+    assert t.gather_mode == "batch"
+
+
+def test_auto_picks_shard_when_round_covers_shard():
+    """Epoch-sync rounds revisit the whole shard (K*B >= n_max), where
+    moving rows would inflate the footprint — auto must pick shard."""
+    cfg = ExperimentConfig(
+        data=DataConfig(dataset="synthetic", synthetic_dim=20,
+                        batch_size=16),
+        federated=FederatedConfig(federated=True, num_clients=8,
+                                  online_client_rate=0.5,
+                                  algorithm="fedavg", sync_type="epoch",
+                                  num_epochs_per_comm=2),
+        model=ModelConfig(arch="logistic_regression"),
+    ).finalize()
+    data = build_federated_data(cfg)
+    model = define_model(cfg, batch_size=16)
+    t = FederatedTrainer(cfg, model, make_algorithm(cfg), data.train)
+    assert t.local_steps * t.batch_size >= int(data.train.n_max)
+    assert t.gather_mode == "shard"
+
+
+def test_qffl_requires_shard():
+    t = _build("auto", "qffl", qffl_q=1.0)
+    assert t.gather_mode == "shard"
+    with pytest.raises(ValueError, match="gather_mode"):
+        _build("batch", "qffl", qffl_q=1.0)
+
+
+def test_unknown_mode_rejected():
+    with pytest.raises(ValueError, match="gather_mode"):
+        _build("rows")
