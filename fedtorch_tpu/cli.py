@@ -136,6 +136,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--correct_wd", type=str2bool, default=False)
     # misc / checkpoint (parameters.py:196-222)
     p.add_argument("--manual_seed", type=int, default=6)
+    p.add_argument("--per_class_acc", type=str2bool, default=False)
     p.add_argument("--evaluate", "-e", type=str2bool, default=False)
     p.add_argument("--eval_freq", type=int, default=1)
     p.add_argument("--summary_freq", type=int, default=10)
@@ -241,7 +242,8 @@ def args_to_config(args) -> ExperimentConfig:
             turn_off_local_step_from=args.turn_off_local_step_from,
             avg_model=args.avg_model, manual_seed=args.manual_seed,
             evaluate=args.evaluate, eval_freq=args.eval_freq,
-            summary_freq=args.summary_freq),
+            summary_freq=args.summary_freq,
+            per_class_acc=args.per_class_acc),
         checkpoint=CheckpointConfig(
             checkpoint_dir=args.checkpoint, resume=args.resume,
             checkpoint_index=args.checkpoint_index,
@@ -369,6 +371,14 @@ def run_experiment(cfg: ExperimentConfig,
             best_prec1 = max(best_prec1, top1)
             logger.log_val(r, "test", float(res.loss), top1,
                            float(res.top5), best=best_prec1)
+            if cfg.train.per_class_acc:
+                from fedtorch_tpu.models.common import num_classes_of
+                from fedtorch_tpu.parallel import evaluate_per_class
+                accs, counts = evaluate_per_class(
+                    model, server.params, fed_data.test_x,
+                    fed_data.test_y, num_classes_of(cfg.data.dataset))
+                logger.log("Round: {}. Per-class acc: {}".format(
+                    r, [round(float(a), 4) for a in accs]))
             timer.start("checkpoint")
             save_checkpoint(ckpt_dir, server, clients, cfg, best_prec1,
                             is_best,

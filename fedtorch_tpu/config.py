@@ -184,6 +184,9 @@ class TrainConfig:
     evaluate: bool = False
     eval_freq: int = 1
     summary_freq: int = 10
+    # report per-class validation accuracy (--per_class_acc,
+    # parameters.py:98-99)
+    per_class_acc: bool = False
 
 
 @dataclass(frozen=True)

@@ -65,10 +65,13 @@ def accuracy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
 
 
 def per_class_accuracy(logits: jnp.ndarray, labels: jnp.ndarray,
-                       num_classes: int):
-    """metrics.py:77-91: (correct_count, total_count) per class."""
+                       num_classes: int, mask: jnp.ndarray = None):
+    """metrics.py:77-91: (correct_count, total_count) per class.
+    ``mask`` [B] zeroes padding rows out of both counts."""
     pred = jnp.argmax(logits, axis=-1)
     onehot = jax.nn.one_hot(labels, num_classes)
+    if mask is not None:
+        onehot = onehot * mask[:, None]
     correct = (pred == labels)[:, None] * onehot
     return correct.sum(0), onehot.sum(0)
 

@@ -55,7 +55,7 @@ def define_model(cfg: ExperimentConfig, batch_size: int = 2) -> ModelDef:
     dataset = cfg.data.dataset
     m = cfg.model
     _DTYPE_ARCHES = ("resnet", "wideresnet", "densenet", "cnn", "mlp",
-                     "robust_mlp")
+                     "robust_mlp", "transformer")
     if cfg.mesh.compute_dtype != "float32" \
             and not arch.startswith(_DTYPE_ARCHES):
         import warnings
@@ -117,4 +117,16 @@ def define_model(cfg: ExperimentConfig, batch_size: int = 2) -> ModelDef:
                          hidden_size=m.rnn_hidden_size)
         sample = jnp.zeros((batch_size, m.rnn_seq_len), jnp.int32)
         return ModelDef(arch, module, sample, is_recurrent=True)
+    if arch == "transformer":
+        from fedtorch_tpu.models.transformer import TransformerLM
+        d_model = m.rnn_hidden_size * 2
+        # head count must divide the width; degrade gracefully for odd
+        # hidden sizes instead of crashing in attention
+        num_heads = next(h for h in (4, 2, 1) if d_model % h == 0)
+        module = TransformerLM(vocab_size=m.vocab_size, d_model=d_model,
+                               num_heads=num_heads,
+                               num_layers=m.mlp_num_layers,
+                               dtype=cfg.mesh.compute_dtype)
+        sample = jnp.zeros((batch_size, m.rnn_seq_len), jnp.int32)
+        return ModelDef(arch, module, sample)
     raise ValueError(f"Unknown architecture {arch!r}")

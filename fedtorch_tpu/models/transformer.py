@@ -1,0 +1,107 @@
+"""Causal transformer language model — new TPU-first scope.
+
+The reference's only sequence model is the char-GRU (SURVEY.md §5.7); this
+adds a modern attention LM that slots into the same federated engine
+(feed-forward signature: ``[B, T] ints -> [B, T, vocab]`` logits, CE over
+the time axis handled by core.losses) and whose attention can run
+sequence-parallel for long contexts: ``long_context_apply`` swaps the
+per-block dense attention for the exact ring attention of
+``parallel/sequence.py`` with the sequence axis sharded over a mesh axis.
+
+Pre-norm blocks, learned positional embeddings, GELU MLP; compute dtype
+configurable like the rest of the zoo (params/norm-statistics in f32).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class _SelfAttention(nn.Module):
+    num_heads: int
+    dtype: str = "float32"
+
+    @nn.compact
+    def __call__(self, x, attn_override=None):
+        dt = jnp.dtype(self.dtype)
+        d_model = x.shape[-1]
+        head_dim = d_model // self.num_heads
+        qkv = nn.Dense(3 * d_model, use_bias=False, dtype=dt,
+                       name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = x.shape[:-1] + (self.num_heads, head_dim)
+        q, k, v = (t.reshape(shape) for t in (q, k, v))
+        if attn_override is not None:
+            # sequence-parallel ring attention ([B, T, H, D] in/out)
+            out = attn_override(q, k, v)
+        else:
+            scale = 1.0 / math.sqrt(head_dim)
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+            t_len = x.shape[1]
+            mask = jnp.tril(jnp.ones((t_len, t_len), bool))
+            scores = jnp.where(mask[None, None], scores, -jnp.inf)
+            probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(dt), v)
+        out = out.reshape(x.shape[:-1] + (d_model,))
+        return nn.Dense(d_model, use_bias=False, dtype=dt,
+                        name="proj")(out)
+
+
+class _Block(nn.Module):
+    num_heads: int
+    mlp_ratio: int = 4
+    dtype: str = "float32"
+
+    @nn.compact
+    def __call__(self, x, attn_override=None):
+        dt = jnp.dtype(self.dtype)
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(dt)
+        x = x + _SelfAttention(self.num_heads, self.dtype,
+                               name="attn")(h, attn_override)
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(dt)
+        h = nn.Dense(self.mlp_ratio * x.shape[-1], dtype=dt,
+                     name="mlp_in")(h)
+        h = nn.gelu(h)
+        x = x + nn.Dense(x.shape[-1], dtype=dt, name="mlp_out")(h)
+        return x
+
+
+class TransformerLM(nn.Module):
+    vocab_size: int = 86
+    d_model: int = 128
+    num_heads: int = 4
+    num_layers: int = 2
+    max_len: int = 2048
+    dtype: str = "float32"
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False, attn_override=None):
+        dt = jnp.dtype(self.dtype)
+        t_len = tokens.shape[1]
+        x = nn.Embed(self.vocab_size, self.d_model, name="tok_embed")(
+            tokens).astype(dt)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (self.max_len, self.d_model))
+        x = x + pos[:t_len].astype(dt)
+        for i in range(self.num_layers):
+            x = _Block(self.num_heads, dtype=self.dtype,
+                       name=f"block_{i}")(x, attn_override)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        return nn.Dense(self.vocab_size, name="head")(x)
+
+
+def long_context_apply(module: TransformerLM, params, tokens, mesh,
+                       axis_name: str = "sp"):
+    """Forward with every attention block running exact ring attention,
+    the sequence axis sharded over ``mesh``'s ``axis_name``."""
+    from fedtorch_tpu.parallel.sequence import ring_attention
+
+    def attn(q, k, v):
+        return ring_attention(q, k, v, mesh, axis_name=axis_name,
+                              causal=True)
+
+    return module.apply({"params": params}, tokens, attn_override=attn)

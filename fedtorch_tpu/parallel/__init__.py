@@ -1,5 +1,5 @@
 from fedtorch_tpu.parallel.evaluate import (  # noqa: F401
-    evaluate, evaluate_clients, evaluate_personal,
+    evaluate, evaluate_clients, evaluate_per_class, evaluate_personal,
 )
 from fedtorch_tpu.parallel.federated import FederatedTrainer  # noqa: F401
 from fedtorch_tpu.parallel.local_sgd import (  # noqa: F401

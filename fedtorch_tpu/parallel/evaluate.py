@@ -36,7 +36,8 @@ def _pad_batches(x: np.ndarray, y: np.ndarray, batch_size: int):
         y = np.concatenate([y, y[idx]])
     mask = np.concatenate([np.ones(n), np.zeros(pad)])
     return (x.reshape((n_batches, batch_size) + x.shape[1:]),
-            y.reshape(n_batches, batch_size),
+            # y may be [N] class labels or [N, T] sequence targets
+            y.reshape((n_batches, batch_size) + y.shape[1:]),
             mask.reshape(n_batches, batch_size))
 
 
@@ -114,12 +115,15 @@ def evaluate(model: ModelDef, params, x: np.ndarray, y: np.ndarray,
                 if model.is_recurrent:
                     logits, _ = model.apply(
                         params, xb, carry=model.init_carry(xb.shape[0]))
-                    # per-sample over the flattened time axis
-                    logits = logits.reshape(-1, logits.shape[-1])
-                    yb_f = yb.reshape(-1)
-                    mb_f = jnp.repeat(mb, yb.shape[-1])
                 else:
                     logits = model.apply(params, xb)
+                if logits.ndim == 3:
+                    # sequence model ([B, T, V] logits, [B, T] targets):
+                    # per-token statistics over the flattened time axis
+                    mb_f = jnp.repeat(mb, yb.shape[-1])
+                    logits = logits.reshape(-1, logits.shape[-1])
+                    yb_f = yb.reshape(-1)
+                else:
                     yb_f, mb_f = yb, mb
                 # per-sample statistics masked so padding rows (duplicates
                 # of the head of the split) contribute nothing
@@ -195,6 +199,52 @@ def evaluate_clients(model: ModelDef, client_params, data,
         "acc_var": float(jnp.var(accs)),
     }
     return losses, accs, summary
+
+
+_PER_CLASS_CACHE = {}
+
+
+def evaluate_per_class(model: ModelDef, params, x: np.ndarray,
+                       y: np.ndarray, num_classes: int,
+                       batch_size: int = 256,
+                       robust_ascent: bool = True):
+    """Per-class accuracy (components/metrics.py:77-91; --per_class_acc
+    flag, parameters.py:98-99): returns [num_classes] accuracy plus the
+    per-class sample counts. Robust archs get the same adversarial
+    noise-ascent prelude as :func:`evaluate`, keeping the decomposition
+    consistent with the reported top1."""
+    from fedtorch_tpu.core.losses import per_class_accuracy
+    bx, by, bm = _pad_batches(np.asarray(x), np.asarray(y), batch_size)
+    bx, by, bm = jnp.asarray(bx), jnp.asarray(by), jnp.asarray(bm)
+    if model.has_noise_param and robust_ascent:
+        params = _ascent_on_batches(model, params, bx, by, bm)
+
+    key = (model.module, model.is_recurrent, num_classes)
+    if key not in _PER_CLASS_CACHE:
+        def run(params, bx, by, bm):
+            def body(carry, batch):
+                xb, yb, mb = batch
+                if model.is_recurrent:
+                    logits, _ = model.apply(
+                        params, xb, carry=model.init_carry(xb.shape[0]))
+                else:
+                    logits = model.apply(params, xb)
+                if logits.ndim == 3:
+                    mb = jnp.repeat(mb, yb.shape[-1])
+                    logits = logits.reshape(-1, logits.shape[-1])
+                    yb = yb.reshape(-1)
+                correct, total = per_class_accuracy(logits, yb,
+                                                    num_classes, mask=mb)
+                c_sum, t_sum = carry
+                return (c_sum + correct, t_sum + total), None
+
+            (c_sum, t_sum), _ = jax.lax.scan(
+                body, (jnp.zeros(num_classes), jnp.zeros(num_classes)),
+                (bx, by, bm))
+            return c_sum / jnp.maximum(t_sum, 1.0), t_sum
+
+        _PER_CLASS_CACHE[key] = jax.jit(run)
+    return _PER_CLASS_CACHE[key](params, bx, by, bm)
 
 
 def evaluate_personal(model: ModelDef, client_aux, client_params, data,
