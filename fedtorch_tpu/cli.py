@@ -45,6 +45,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-p", "--data_dir", default="./data/")
     p.add_argument("--download", type=str2bool, default=False)
     p.add_argument("--partition_data", type=str2bool, default=True)
+    p.add_argument("--augment", type=str2bool, default=None,
+                   help="train-time flip+crop for image data "
+                        "(default: on for the cifar family)")
     p.add_argument("--synthetic_alpha", type=float, default=0.0)
     p.add_argument("--synthetic_beta", type=float, default=0.0)
     p.add_argument("--sensitive_feature", type=int, default=9)
@@ -179,7 +182,8 @@ def args_to_config(args) -> ExperimentConfig:
             growing_batch_size=args.growing_batch_size,
             base_batch_size=args.base_batch_size,
             max_batch_size=args.max_batch_size,
-            reshuffle_per_epoch=args.reshuffle_per_epoch),
+            reshuffle_per_epoch=args.reshuffle_per_epoch,
+            augment=args.augment),
         federated=FederatedConfig(
             federated=args.federated, num_clients=args.num_workers,
             num_comms=args.num_comms,

@@ -65,6 +65,9 @@ class DataConfig:
     reshuffle_per_epoch: bool = False
     # Personalization val split sizes mirror dataset.py:168-211.
     val_fraction: float = 0.2
+    # train-time flip+crop augmentation (prepare_data.py:29-35 applies it
+    # for the cifar family); None = on for cifar/stl10, off otherwise
+    augment: Optional[bool] = None
 
 
 @dataclass(frozen=True)
@@ -242,6 +245,13 @@ class ExperimentConfig:
 
         if data.growing_batch_size and data.base_batch_size is None:
             data = dataclasses.replace(data, base_batch_size=1)
+
+        if data.augment is None:
+            # reference default: augmentation ONLY for the cifar family
+            # (_get_cifar, prepare_data.py:29-35; _get_stl10 passes the
+            # transform through untouched)
+            data = dataclasses.replace(
+                data, augment=data.dataset in ("cifar10", "cifar100"))
 
         if fed.federated:
             if data.reshuffle_per_epoch:

@@ -1,0 +1,34 @@
+"""In-graph image augmentation.
+
+The reference's CIFAR training pipeline applies RandomHorizontalFlip +
+RandomCrop(32, padding=4) on the host per batch (prepare_data.py:29-35);
+here the same augmentation is a jittable per-sample transform applied
+inside the training scan — no host round-trips, fresh randomness per
+local step from the threaded PRNG.
+
+One deliberate difference: the reference crops in raw pixel space before
+normalization (zero-padding = black border), while this operates on
+normalized tensors (zero-padding = per-channel mean border). The crop
+statistics are otherwise identical.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def augment_image_batch(rng: jax.Array, x: jnp.ndarray,
+                        pad: int = 4) -> jnp.ndarray:
+    """Random horizontal flip + pad-and-crop, per sample. x: [B,H,W,C]."""
+    b, h, w, c = x.shape
+    r_flip, r_top, r_left = jax.random.split(rng, 3)
+    flip = jax.random.bernoulli(r_flip, 0.5, (b,))
+    x = jnp.where(flip[:, None, None, None], x[:, :, ::-1, :], x)
+    xp = jnp.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+    tops = jax.random.randint(r_top, (b,), 0, 2 * pad + 1)
+    lefts = jax.random.randint(r_left, (b,), 0, 2 * pad + 1)
+
+    def crop(img, top, left):
+        return jax.lax.dynamic_slice(img, (top, left, 0), (h, w, c))
+
+    return jax.vmap(crop)(xp, tops, lefts)

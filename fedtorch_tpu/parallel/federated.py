@@ -47,6 +47,7 @@ from fedtorch_tpu.core.state import (
 from fedtorch_tpu.data.batching import ClientData, epoch_permutation, \
     take_batch
 from fedtorch_tpu.models.common import ModelDef
+from fedtorch_tpu.ops.augment import augment_image_batch
 from fedtorch_tpu.parallel.mesh import client_sharding, make_mesh, \
     replicate, shard_clients
 
@@ -114,6 +115,10 @@ class FederatedTrainer:
                 f"{algorithm.name} requires gather_mode='shard' "
                 "(it evaluates the full local dataset each round)")
         self.gather_mode = gather_mode
+        # train-time flip+crop augmentation for image batches (the
+        # reference's cifar transform, prepare_data.py:29-35);
+        # ClientData x is [clients, N, H, W, C] for image datasets
+        self.augment = bool(cfg.data.augment) and data.x.ndim == 5
 
         num_epochs = cfg.train.num_epochs or 1
         self.schedule: LRSchedule = compile_schedule(
@@ -292,6 +297,13 @@ class FederatedTrainer:
                                                     k, B)
                 else:
                     bval_x = bval_y = None
+                if self.augment:
+                    # separate stream from drop_rng's fold(k+1): derive
+                    # from a disjoint parent key so no step count can
+                    # collide the two
+                    aug_parent = jax.random.fold_in(rng_c, -1)
+                    bx = augment_image_batch(
+                        jax.random.fold_in(aug_parent, k), bx)
                 drop_rng = jax.random.fold_in(rng_c, k + 1)
                 params, opt, aux, rnn_carry, loss, acc = alg.local_step(
                     params=params, opt=opt, client_aux=aux,
