@@ -299,9 +299,9 @@ class FederatedTrainer:
                     bval_x = bval_y = None
                 if self.augment:
                     # separate stream from drop_rng's fold(k+1): derive
-                    # from a disjoint parent key so no step count can
-                    # collide the two
-                    aug_parent = jax.random.fold_in(rng_c, -1)
+                    # from a disjoint parent key (folds are uint32; K can
+                    # never reach 2^31 steps) so the two cannot collide
+                    aug_parent = jax.random.fold_in(rng_c, 0x7FFFFFFF)
                     bx = augment_image_batch(
                         jax.random.fold_in(aug_parent, k), bx)
                 drop_rng = jax.random.fold_in(rng_c, k + 1)
