@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 
 from fedtorch_tpu.algorithms.base import FedAlgorithm
-from fedtorch_tpu.core.state import tree_scale, tree_zeros_like
+from fedtorch_tpu.core.state import tree_zeros_like
 from fedtorch_tpu.ops.topk import topk_roundtrip
 
 

@@ -272,7 +272,7 @@ def run_experiment(cfg: ExperimentConfig,
     import jax.numpy as jnp
 
     from fedtorch_tpu.algorithms import make_algorithm
-    from fedtorch_tpu.core.schedule import compile_schedule, lr_at
+    from fedtorch_tpu.core.schedule import lr_at
     from fedtorch_tpu.data import build_federated_data
     from fedtorch_tpu.models import define_model
     from fedtorch_tpu.parallel import (

@@ -18,7 +18,7 @@ server-step escape hatch (``adam.py:69-70``).
 """
 from __future__ import annotations
 
-from typing import NamedTuple, Optional
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp

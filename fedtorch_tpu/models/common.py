@@ -15,7 +15,7 @@ as the TPU-friendly alternative (``ModelConfig.norm='gn'``).
 """
 from __future__ import annotations
 
-from typing import Any, Callable, NamedTuple, Optional
+from typing import Any, NamedTuple
 
 import flax.linen as nn
 import jax

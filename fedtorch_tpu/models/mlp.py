@@ -10,7 +10,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from fedtorch_tpu.models.common import (
-    BatchStatsNorm, flat_input_size, make_norm, norm_f32, num_classes_of,
+    flat_input_size, norm_f32, num_classes_of,
 )
 from fedtorch_tpu.models.linear import _noise_init
 

@@ -14,8 +14,6 @@ configurable like the rest of the zoo (params/norm-statistics in f32).
 from __future__ import annotations
 
 import math
-from typing import Optional
-
 import flax.linen as nn
 import jax
 import jax.numpy as jnp

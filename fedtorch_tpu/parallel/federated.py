@@ -29,7 +29,7 @@ Design (SURVEY.md §7):
 from __future__ import annotations
 
 import math
-from typing import Any, NamedTuple, Optional, Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -42,14 +42,13 @@ from fedtorch_tpu.core.losses import make_criterion, per_sample_loss
 from fedtorch_tpu.core.schedule import LRSchedule, compile_schedule, lr_at
 from fedtorch_tpu.core.state import (
     ClientState, RoundMetrics, ServerState, tree_bytes, tree_sub,
-    tree_where,
 )
 from fedtorch_tpu.data.batching import ClientData, epoch_permutation, \
     take_batch
 from fedtorch_tpu.models.common import ModelDef
 from fedtorch_tpu.ops.augment import augment_image_batch
-from fedtorch_tpu.parallel.mesh import client_sharding, make_mesh, \
-    replicate, shard_clients
+from fedtorch_tpu.parallel.mesh import make_mesh, replicate, \
+    shard_clients
 
 
 def participation_indices(rng: jax.Array, num_clients: int, k: int,
