@@ -39,7 +39,7 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def probe_device(timeout_s: int = 300) -> bool:
+def probe_device(timeout_s: int = 120) -> bool:
     """Check that the default JAX platform initializes, in a SUBPROCESS
     with a timeout: the TPU relay in this container can wedge
     indefinitely, and a hung bench is worse than a CPU fallback."""
@@ -89,11 +89,21 @@ def measure_torch_baseline() -> float:
 
 
 def main():
+    global ONLINE_RATE, TIMED_ROUNDS, SAMPLES_PER_CLIENT
+    global BATCH_SIZE, LOCAL_STEPS
     fallback_cpu = not probe_device()
     if fallback_cpu:
         log("TPU unavailable — benchmarking on CPU (numbers will be low; "
-            "rerun when the TPU relay recovers)")
+            "rerun when the TPU relay recovers). Shrinking the timed "
+            "workload so the run finishes promptly; steps/sec/chip stays "
+            "an honest per-step rate.")
         os.environ["JAX_PLATFORMS"] = "cpu"
+        global BATCH_SIZE, LOCAL_STEPS
+        ONLINE_RATE = 0.01   # 1 online client/round
+        TIMED_ROUNDS = 1
+        LOCAL_STEPS = 5
+        BATCH_SIZE = 16
+        SAMPLES_PER_CLIENT = 64
 
     import numpy as np
     import jax
@@ -114,8 +124,10 @@ def main():
 
     from fedtorch_tpu.config import MeshConfig
     # bf16 conv/matmul compute on the MXU (params/norms stay f32);
-    # override with BENCH_DTYPE=float32 for a full-precision run
-    dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
+    # override with BENCH_DTYPE=float32 for a full-precision run.
+    # CPU fallback forces f32 (bf16 is software-emulated there).
+    dtype = "float32" if fallback_cpu \
+        else os.environ.get("BENCH_DTYPE", "bfloat16")
     log(f"compute dtype: {dtype}")
     cfg = ExperimentConfig(
         data=DataConfig(dataset="cifar10", batch_size=BATCH_SIZE),
