@@ -28,6 +28,7 @@ from fedtorch_tpu.models.wideresnet import WideResNet, build_wideresnet
 MODEL_NAMES = (
     "logistic_regression", "robust_logistic_regression", "least_square",
     "robust_least_square", "mlp", "robust_mlp", "cnn", "rnn",
+    "transformer",
     # prefix families:
     "resnet*", "wideresnet*", "densenet*",
 )
@@ -54,15 +55,6 @@ def define_model(cfg: ExperimentConfig, batch_size: int = 2) -> ModelDef:
     arch = cfg.model.arch
     dataset = cfg.data.dataset
     m = cfg.model
-    _DTYPE_ARCHES = ("resnet", "wideresnet", "densenet", "cnn", "mlp",
-                     "robust_mlp", "transformer")
-    if cfg.mesh.compute_dtype != "float32" \
-            and not arch.startswith(_DTYPE_ARCHES):
-        import warnings
-        warnings.warn(
-            f"compute_dtype={cfg.mesh.compute_dtype!r} is not wired into "
-            f"{arch!r}; it runs in float32", stacklevel=2)
-
     if arch.startswith("wideresnet"):
         module = build_wideresnet(arch, dataset, m.wideresnet_widen_factor,
                                   m.drop_rate, m.norm,
@@ -79,19 +71,23 @@ def define_model(cfg: ExperimentConfig, batch_size: int = 2) -> ModelDef:
                                 dtype=cfg.mesh.compute_dtype)
         return ModelDef(arch, module, _sample_image(dataset, batch_size))
     if arch == "logistic_regression":
-        return ModelDef(arch, LogisticRegression(dataset=dataset),
+        return ModelDef(arch, LogisticRegression(
+            dataset=dataset, dtype=cfg.mesh.compute_dtype),
                         _sample_flat(dataset, batch_size, cfg.data.synthetic_dim))
     if arch == "robust_logistic_regression":
-        return ModelDef(arch, LogisticRegression(dataset=dataset, robust=True),
+        return ModelDef(arch, LogisticRegression(
+            dataset=dataset, robust=True, dtype=cfg.mesh.compute_dtype),
                         _sample_flat(dataset, batch_size, cfg.data.synthetic_dim),
                         has_noise_param=True)
     if arch == "least_square":
-        return ModelDef(arch, LeastSquare(dataset=dataset),
+        return ModelDef(arch, LeastSquare(dataset=dataset,
+                                          dtype=cfg.mesh.compute_dtype),
                         _sample_regression(dataset, batch_size,
                                            cfg.data.synthetic_dim),
                         is_regression=True)
     if arch == "robust_least_square":
-        return ModelDef(arch, LeastSquare(dataset=dataset, robust=True),
+        return ModelDef(arch, LeastSquare(dataset=dataset, robust=True,
+                                          dtype=cfg.mesh.compute_dtype),
                         _sample_regression(dataset, batch_size,
                                            cfg.data.synthetic_dim),
                         is_regression=True, has_noise_param=True)
@@ -114,7 +110,8 @@ def define_model(cfg: ExperimentConfig, batch_size: int = 2) -> ModelDef:
                         _sample_image(dataset, batch_size))
     if arch == "rnn":
         module = CharGRU(vocab_size=m.vocab_size,
-                         hidden_size=m.rnn_hidden_size)
+                         hidden_size=m.rnn_hidden_size,
+                         dtype=cfg.mesh.compute_dtype)
         sample = jnp.zeros((batch_size, m.rnn_seq_len), jnp.int32)
         return ModelDef(arch, module, sample, is_recurrent=True)
     if arch == "transformer":

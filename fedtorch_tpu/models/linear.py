@@ -34,6 +34,8 @@ def _noise_init(std: float = 0.001):
 class LogisticRegression(nn.Module):
     dataset: str
     robust: bool = False
+    # compute dtype for the (single) matmul; params and logits stay f32
+    dtype: str = "float32"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -43,6 +45,7 @@ class LogisticRegression(nn.Module):
         # class count from the reference dims table; feature count inferred
         # from the input so configurable datasets (synthetic_dim) work
         num_classes = CONVEX_DIMS[self.dataset][1]
+        dt = jnp.dtype(self.dtype)
         if self.dataset in _FLATTEN_DATASETS:
             x = x.reshape((x.shape[0], -1))
         if self.robust:
@@ -50,22 +53,25 @@ class LogisticRegression(nn.Module):
             x = x + noise
         # Zero init matches logistic_regression.py:75-80.
         return nn.Dense(num_classes, kernel_init=nn.initializers.zeros,
-                        bias_init=nn.initializers.zeros)(x)
+                        bias_init=nn.initializers.zeros,
+                        dtype=dt)(x.astype(dt)).astype(jnp.float32)
 
 
 class LeastSquare(nn.Module):
     dataset: str
     robust: bool = False
+    dtype: str = "float32"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         if self.dataset not in REGRESSION_DIMS:
             raise ValueError(
                 f"least squares does not support dataset {self.dataset!r}")
+        dt = jnp.dtype(self.dtype)
         if self.robust:
             noise = self.param("noise", _noise_init(), (x.shape[-1],))
             x = x + noise
-        return nn.Dense(1)(x)
+        return nn.Dense(1, dtype=dt)(x.astype(dt)).astype(jnp.float32)
 
 
 class LinearMAFL(nn.Module):
@@ -73,8 +79,12 @@ class LinearMAFL(nn.Module):
     in_features: int
     middle_features: int
     out_features: int = 1
+    dtype: str = "float32"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        z = nn.Dense(self.middle_features, use_bias=False, name="Z")(x)
-        return nn.Dense(self.out_features, use_bias=True, name="W")(z)
+        dt = jnp.dtype(self.dtype)
+        z = nn.Dense(self.middle_features, use_bias=False, name="Z",
+                     dtype=dt)(x.astype(dt))
+        return nn.Dense(self.out_features, use_bias=True,
+                        name="W")(z.astype(jnp.float32))

@@ -17,21 +17,28 @@ class CharGRU(nn.Module):
     vocab_size: int = 86
     hidden_size: int = 50
     n_layers: int = 1
+    # compute dtype: params stay f32 (flax param_dtype default); the
+    # embedding/GRU matmuls and the carried hidden state run in `dtype`
+    # so bf16 hits the MXU; the decoder head computes in f32
+    dtype: str = "float32"
 
     @nn.compact
     def __call__(self, tokens, carry):
-        """tokens: [B, T] int; carry: [n_layers, B, hidden]."""
-        x = nn.Embed(self.vocab_size, self.hidden_size)(tokens)
+        """tokens: [B, T] int; carry: [n_layers, B, hidden] in `dtype`."""
+        dt = jnp.dtype(self.dtype)
+        x = nn.Embed(self.vocab_size, self.hidden_size, dtype=dt)(tokens)
         new_carries = []
         for layer in range(self.n_layers):
-            cell = nn.GRUCell(features=self.hidden_size,
+            cell = nn.GRUCell(features=self.hidden_size, dtype=dt,
                               name=f"gru_l{layer}")
             layer_carry, x = nn.RNN(cell, return_carry=True,
                                     name=f"rnn_l{layer}")(
-                x, initial_carry=carry[layer])
+                x, initial_carry=carry[layer].astype(dt))
             new_carries.append(layer_carry)
-        logits = nn.Dense(self.vocab_size, name="decoder")(x)
+        logits = nn.Dense(self.vocab_size, name="decoder")(
+            x.astype(jnp.float32))
         return logits, jnp.stack(new_carries)
 
     def initial_carry(self, batch_size: int):
-        return jnp.zeros((self.n_layers, batch_size, self.hidden_size))
+        return jnp.zeros((self.n_layers, batch_size, self.hidden_size),
+                         jnp.dtype(self.dtype))

@@ -160,6 +160,68 @@ def test_dropout_needs_rng_and_is_stochastic():
     np.testing.assert_allclose(np.asarray(e1), np.asarray(e2))
 
 
+def _cfg_dtype(arch, dataset, dtype, **model_kw):
+    from fedtorch_tpu.config import MeshConfig
+    return ExperimentConfig(data=DataConfig(dataset=dataset),
+                            model=ModelConfig(arch=arch, **model_kw),
+                            mesh=MeshConfig(compute_dtype=dtype))
+
+
+@pytest.mark.parametrize("arch,dataset", [
+    ("rnn", "shakespeare"),
+    ("logistic_regression", "mnist"),
+    ("robust_logistic_regression", "mnist"),
+    ("least_square", "MSD"),
+    ("transformer", "shakespeare"),
+])
+def test_bf16_compute_dtype_wired(arch, dataset):
+    """compute_dtype=bfloat16 must reach every model family: params stay
+    f32 (mixed precision keeps master weights), the forward runs finite,
+    and training (grad step) stays finite. Closes the
+    models/__init__ warning path for the rnn/linear tail."""
+    model = define_model(_cfg_dtype(arch, dataset, "bfloat16"))
+    params = model.init(jax.random.key(0))
+    # master params stay f32
+    for leaf in jax.tree.leaves(params):
+        assert leaf.dtype == jnp.float32, leaf.dtype
+    if arch == "rnn":
+        x = jnp.ones((4, 50), jnp.int32)
+        carry = model.init_carry(4)
+        assert carry.dtype == jnp.bfloat16
+        logits, carry2 = model.apply(params, x, carry=carry)
+        assert carry2.dtype == jnp.bfloat16
+    elif arch == "transformer":
+        x = jnp.ones((4, 50), jnp.int32)
+        logits = model.apply(params, x)
+    else:
+        x = jnp.ones_like(model.sample_input)
+        logits = model.apply(params, x)
+    assert bool(jnp.all(jnp.isfinite(logits.astype(jnp.float32))))
+
+
+def test_bf16_gru_training_step_finite_and_f32_invariant():
+    """One SGD step on the bf16 GRU: loss finite, updated params remain
+    f32 (VERDICT r1 item 7 done-criteria)."""
+    from fedtorch_tpu.core.losses import make_criterion
+
+    model = define_model(_cfg_dtype("rnn", "shakespeare", "bfloat16"))
+    params = model.init(jax.random.key(0))
+    criterion = make_criterion(False)
+    tokens = jax.random.randint(jax.random.key(1), (4, 50), 0, 86)
+    targets = jax.random.randint(jax.random.key(2), (4, 50), 0, 86)
+
+    def loss_fn(p):
+        logits, _ = model.apply(p, tokens, carry=model.init_carry(4))
+        return criterion(logits, targets)
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    assert np.isfinite(float(loss))
+    new_params = jax.tree.map(lambda p, g: p - 0.1 * g, params, grads)
+    for leaf in jax.tree.leaves(new_params):
+        assert leaf.dtype == jnp.float32
+    assert np.isfinite(float(loss_fn(new_params)))
+
+
 def test_unknown_arch_raises():
     with pytest.raises(ValueError):
         define_model(_cfg("transformerXL", "mnist"))
