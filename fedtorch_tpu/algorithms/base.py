@@ -166,6 +166,16 @@ class FedAlgorithm:
         ``full_loss`` is provided when ``needs_full_loss`` is set."""
         return tree_scale(delta, weight), client_aux
 
+    def aggregate_transform(self, payload_sum):
+        """Downlink wire-format transform of the aggregated payload.
+
+        The engine applies this ONCE after the aggregation collective, so
+        ``server_update`` and ``client_post`` consume the SAME transformed
+        sum — matching the reference, which re-quantizes the aggregated
+        tensor server-side and broadcasts THAT to clients
+        (fedavg.py:54-64, fedgate.py:74-79). Identity by default."""
+        return payload_sum
+
     def server_update(self, server_params, server_opt, server_aux,
                       payload_sum, *, online_idx, num_online_eff,
                       client_losses=None):

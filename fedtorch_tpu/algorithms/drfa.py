@@ -129,6 +129,11 @@ class DRFA(FedAlgorithm):
                                      1.0 / self.k_online)}
         return payload, dict(client_aux, inner=inner_aux)
 
+    def aggregate_transform(self, payload_sum):
+        return dict(payload_sum,
+                    inner=self.inner.aggregate_transform(
+                        payload_sum["inner"]))
+
     def server_update(self, server_params, server_opt, server_aux,
                       payload_sum, *, online_idx, num_online_eff,
                       client_losses=None):

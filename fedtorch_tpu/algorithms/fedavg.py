@@ -37,9 +37,7 @@ class FedAvg(FedAlgorithm):
                 lambda x: quantize_dequantize(x, bits), payload)
         return payload, client_aux
 
-    def server_update(self, server_params, server_opt, server_aux,
-                      payload_sum, *, online_idx, num_online_eff,
-                      client_losses=None):
+    def aggregate_transform(self, payload_sum):
         if self.cfg.federated.quantized:
             # downlink re-quantization of the summed delta (fedavg.py:54-64)
             # — the fused pallas kernel when on TPU (one VMEM pass), XLA
@@ -49,10 +47,7 @@ class FedAvg(FedAlgorithm):
             bits = self.cfg.federated.quantized_bits
             payload_sum = jax.tree.map(
                 lambda x: fused_quantize_dequantize(x, bits), payload_sum)
-        new_params, new_opt = optim.server_step(
-            server_params, payload_sum, server_opt,
-            self.cfg.optim.lr_scale_at_sync, self.cfg.optim)
-        return new_params, new_opt, server_aux
+        return payload_sum
 
 
 class FedProx(FedAvg):

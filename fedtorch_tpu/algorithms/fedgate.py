@@ -22,7 +22,6 @@ from __future__ import annotations
 import jax
 
 from fedtorch_tpu.algorithms.base import FedAlgorithm
-from fedtorch_tpu.core import optim
 from fedtorch_tpu.core.state import tree_scale, tree_zeros_like
 from fedtorch_tpu.ops.quantize import quantize_dequantize
 from fedtorch_tpu.ops.topk import topk_roundtrip
@@ -60,18 +59,16 @@ class FedGate(FedAlgorithm):
             payload = weighted
         return payload, client_aux
 
-    def server_update(self, server_params, server_opt, server_aux,
-                      payload_sum, *, online_idx, num_online_eff,
-                      client_losses=None):
+    def aggregate_transform(self, payload_sum):
+        # FedCOMGATE downlink: the re-quantized aggregate feeds BOTH the
+        # server step and the clients' tracking/memory updates
+        # (fedgate.py:74-79 broadcasts the re-quantized tensor)
         if self.cfg.federated.quantized:
             from fedtorch_tpu.ops.pallas import fused_quantize_dequantize
             payload_sum = jax.tree.map(
                 lambda x: fused_quantize_dequantize(
                     x, self.cfg.federated.quantized_bits), payload_sum)
-        new_params, new_opt = optim.server_step(
-            server_params, payload_sum, server_opt,
-            self.cfg.optim.lr_scale_at_sync, self.cfg.optim)
-        return new_params, new_opt, server_aux
+        return payload_sum
 
     def client_post(self, *, delta, client_aux, payload_sum, lr,
                     local_steps, server_params, params, weight):

@@ -359,7 +359,7 @@ def run_experiment(cfg: ExperimentConfig,
         n_online = float(jnp.sum(metrics.online_mask))
         loss = float(jnp.sum(metrics.train_loss) / max(n_online, 1))
         acc = float(jnp.sum(metrics.train_acc) / max(n_online, 1))
-        epoch = float(jnp.mean(clients.epoch))
+        epoch = trainer.mean_client_epoch(clients)
         logger.log_train(r, epoch, loss, acc,
                          float(lr_at(schedule, epoch)),
                          comm_bytes=float(metrics.comm_bytes),

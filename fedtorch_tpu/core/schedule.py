@@ -55,7 +55,10 @@ def lr_at(sched: LRSchedule, epoch: jnp.ndarray) -> jnp.ndarray:
     in_field = (sched.starts <= epoch) & (epoch < sched.ends)
     in_field = in_field | (jnp.arange(sched.starts.shape[0])
                            == sched.starts.shape[0] - 1) & (epoch >= sched.ends[-1])
-    return jnp.sum(jnp.where(in_field, per_field, 0.0))
+    # FIRST matching field, like the reference's sequential fall_in scan
+    # (learning.py:62-70) — fields may overlap (e.g. a warmup interval
+    # reaching past the first change epoch) and first-match must win
+    return per_field[jnp.argmax(in_field)]
 
 
 def _parse_fields(lr_fields: str):
@@ -123,10 +126,12 @@ def compile_schedule(lr_cfg: LRConfig, optim_cfg: OptimConfig,
             edges = [0, num_epochs]
         fields = [(lr, lr) for lr in lrs]
         if lr_cfg.warmup:
-            # warmup starts from the *unscaled* lr (learning.py:143-146).
-            fields = [(optim_cfg.lr, base_lr)] + fields[1:]
-            edges = [0, lr_cfg.warmup_epochs] + edges[2:] \
-                if len(edges) > 2 else [0, lr_cfg.warmup_epochs, num_epochs]
+            # the warmup field (unscaled lr -> scaled base lr) is
+            # PREPENDED to the constant fields (learning.py:139-141,
+            # 152-154): the base-LR plateau keeps its own field from
+            # warmup end to the first change epoch.
+            fields = [(optim_cfg.lr, base_lr)] + fields
+            edges = [0, lr_cfg.warmup_epochs] + edges[1:]
         epochs = list(zip(edges[:-1], edges[1:]))
         kinds = ["0"] * len(fields)
     elif scheme == "custom_convex_decay":

@@ -61,9 +61,39 @@ def stack_partitions(features: np.ndarray, labels: np.ndarray,
     x = gather_rows(np.ascontiguousarray(features), idx_all)
     y = gather_rows(np.ascontiguousarray(labels), idx_all)
     C = len(partitions)
-    return ClientData(x=jnp.asarray(x.reshape((C, n_max) + x.shape[1:])),
-                      y=jnp.asarray(y.reshape((C, n_max) + y.shape[1:])),
-                      sizes=jnp.asarray(sizes, jnp.int32))
+    # host (numpy) arrays: padding (pad_client_axis) and device placement
+    # (shard_clients) both happen downstream — staying on host here means
+    # device_put writes each shard straight to its device instead of
+    # staging a full copy on device 0 first
+    return ClientData(x=x.reshape((C, n_max) + x.shape[1:]),
+                      y=y.reshape((C, n_max) + y.shape[1:]),
+                      sizes=np.asarray(sizes, np.int32))
+
+
+def pad_client_axis(data: ClientData, target_clients: int) -> ClientData:
+    """Pad the leading client axis to ``target_clients`` with inert
+    clients (zero rows, size 0) so it shards evenly over a device mesh.
+
+    Padding clients are never selected by participation sampling (which
+    draws from the real client range only) and carry ``sizes == 0`` so any
+    size-masked statistic ignores them."""
+    C = data.num_clients
+    if target_clients == C:
+        return data
+    if target_clients < C:
+        raise ValueError(
+            f"target_clients={target_clients} < num_clients={C}")
+    pad = target_clients - C
+
+    def pad_leaf(a):
+        # host-side when possible: np.concatenate avoids a transient
+        # second full-dataset device allocation for device inputs
+        xp = np if isinstance(a, np.ndarray) else jnp
+        return xp.concatenate(
+            [a, xp.zeros((pad,) + a.shape[1:], a.dtype)], axis=0)
+
+    return ClientData(x=pad_leaf(data.x), y=pad_leaf(data.y),
+                      sizes=pad_leaf(data.sizes))
 
 
 def epoch_permutation(rng: jax.Array, size: jnp.ndarray,

@@ -6,6 +6,6 @@ from fedtorch_tpu.parallel.local_sgd import (  # noqa: F401
     LocalSGDTrainer, build_local_sgd,
 )
 from fedtorch_tpu.parallel.mesh import (  # noqa: F401
-    client_sharding, init_multihost, make_mesh, replicate,
-    replicated_sharding, shard_clients,
+    client_sharding, init_multihost, make_mesh, padded_client_count,
+    replicate, replicated_sharding, shard_clients,
 )

@@ -191,12 +191,20 @@ def evaluate_clients(model: ModelDef, client_params, data,
         return jax.vmap(one)(client_params, data.x, data.y, data.sizes)
 
     losses, accs = run(client_params, data)
+    # size-0 clients are mesh-padding (pad_client_axis) — exclude them
+    # from the cross-client summaries. Masked on-device reductions: the
+    # per-client arrays may span non-addressable devices on a multi-host
+    # mesh, where only replicated scalars can be fetched.
+    valid = jnp.asarray(data.sizes) > 0
+    n = jnp.maximum(jnp.sum(valid), 1).astype(jnp.float32)
+    acc_mean = jnp.sum(jnp.where(valid, accs, 0.0)) / n
     summary = {
-        "loss_mean": float(jnp.mean(losses)),
-        "acc_mean": float(jnp.mean(accs)),
-        "acc_worst": float(jnp.min(accs)),
-        "acc_best": float(jnp.max(accs)),
-        "acc_var": float(jnp.var(accs)),
+        "loss_mean": float(jnp.sum(jnp.where(valid, losses, 0.0)) / n),
+        "acc_mean": float(acc_mean),
+        "acc_worst": float(jnp.min(jnp.where(valid, accs, jnp.inf))),
+        "acc_best": float(jnp.max(jnp.where(valid, accs, -jnp.inf))),
+        "acc_var": float(jnp.sum(
+            jnp.where(valid, jnp.square(accs - acc_mean), 0.0)) / n),
     }
     return losses, accs, summary
 

@@ -44,11 +44,11 @@ from fedtorch_tpu.core.state import (
     ClientState, RoundMetrics, ServerState, tree_bytes, tree_sub,
 )
 from fedtorch_tpu.data.batching import ClientData, epoch_permutation, \
-    take_batch
+    pad_client_axis, take_batch
 from fedtorch_tpu.models.common import ModelDef
 from fedtorch_tpu.ops.augment import augment_image_batch
-from fedtorch_tpu.parallel.mesh import make_mesh, replicate, \
-    shard_clients
+from fedtorch_tpu.parallel.mesh import make_mesh, padded_client_count, \
+    replicate, shard_clients
 
 
 def participation_indices(rng: jax.Array, num_clients: int, k: int,
@@ -130,8 +130,16 @@ class FederatedTrainer:
         algorithm.k_online = self.k_online
         self.mesh = mesh if mesh is not None else make_mesh(
             cfg.mesh, self.num_clients)
-        self.data = shard_clients(data, self.mesh)
-        self.val_data = shard_clients(val_data, self.mesh) \
+        # the client axis is padded up to a multiple of the mesh size with
+        # inert (never-sampled, size-0) clients so EVERY device holds an
+        # equal shard — no chip idles when num_clients has no large
+        # divisor (SURVEY.md §7 [cores, clients_per_core] layout)
+        self.padded_clients = padded_client_count(self.num_clients,
+                                                  self.mesh)
+        self.data = shard_clients(
+            pad_client_axis(data, self.padded_clients), self.mesh)
+        self.val_data = shard_clients(
+            pad_client_axis(val_data, self.padded_clients), self.mesh) \
             if val_data is not None else None
         self._round_jit = jax.jit(self.round_fn, donate_argnums=(0, 1))
 
@@ -145,7 +153,9 @@ class FederatedTrainer:
             aux=self.algorithm.init_server_aux(params, self.num_clients),
             round=jnp.zeros((), jnp.int32),
             rng=rng)
-        C = self.num_clients
+        # client states cover the PADDED axis so they shard evenly; the
+        # padding tail is dead weight that is never gathered by idx
+        C = self.padded_clients
 
         def one_client(_):
             return ClientState(
@@ -183,10 +193,15 @@ class FederatedTrainer:
         rngs = jax.random.split(rng_train, self.k_online)
         batch_mode = self.gather_mode == "batch"
 
+        # disjoint parent fold for the val stream: dropout uses folds
+        # [1, K] and augmentation 0x7FFFFFFF, so val lives at 0x7FFFFFFE
+        # (train's fold 0 is already outside the dropout range)
+        VAL_FOLD = 0x7FFFFFFE
+
         def round_rows(rng_c, size, n_max, fold):
             """The round's row plan: perm[(step*B + j) % size] for all
             K*B (step, j) pairs — the epoch_permutation/take_batch batch
-            order (fold 0 = train stream, 7 = val stream)."""
+            order (fold 0 = train stream, VAL_FOLD = val stream)."""
             perm = epoch_permutation(jax.random.fold_in(rng_c, fold), size,
                                      n_max)
             return perm[jnp.arange(K * B) % jnp.maximum(size, 1)]
@@ -212,7 +227,7 @@ class FederatedTrainer:
             on_vsizes = jnp.take(val_data.sizes, idx)
             if val_batch_mode:
                 vrows = jax.vmap(lambda r, s: round_rows(
-                    r, s, val_data.x.shape[1], 7))(rngs, on_vsizes)
+                    r, s, val_data.x.shape[1], VAL_FOLD))(rngs, on_vsizes)
                 on_vx = val_data.x[idx[:, None], vrows]
                 on_vy = val_data.y[idx[:, None], vrows]
             else:
@@ -276,7 +291,8 @@ class FederatedTrainer:
                 perm = epoch_permutation(jax.random.fold_in(rng_c, 0),
                                          size, x.shape[0])
             if alg.needs_val_batch and not val_batch_mode:
-                vperm = epoch_permutation(jax.random.fold_in(rng_c, 7),
+                vperm = epoch_permutation(jax.random.fold_in(rng_c,
+                                                             VAL_FOLD),
                                           vsize, vx.shape[0])
 
             def step(carry, k):
@@ -334,8 +350,11 @@ class FederatedTrainer:
             client_round)(on_clients, on_x, on_y, on_vx, on_vy, on_sizes,
                           on_vsizes, weights, rngs)
 
-        # the aggregation collective: sum over the (sharded) client axis
-        payload_sum = jax.tree.map(lambda p: jnp.sum(p, axis=0), payloads)
+        # the aggregation collective: sum over the (sharded) client axis,
+        # then the downlink wire-format transform applied ONCE so the
+        # server step and client_post see the same (e.g. re-quantized) sum
+        payload_sum = alg.aggregate_transform(
+            jax.tree.map(lambda p: jnp.sum(p, axis=0), payloads))
 
         new_params, new_opt, new_saux = alg.server_update(
             server.params, server.opt, server.aux, payload_sum,
@@ -379,6 +398,12 @@ class FederatedTrainer:
                                online_mask=mask_full,
                                comm_bytes=comm_bytes)
         return new_server, new_clients, metrics
+
+    def mean_client_epoch(self, clients) -> float:
+        """Mean training epoch over the REAL clients — the one sanctioned
+        reduction over client state: the padded tail (pad_client_axis)
+        never advances, so naive means are biased by real/padded."""
+        return float(jnp.mean(clients.epoch[:self.num_clients]))
 
     # -- host-side round loop ---------------------------------------------
     def run_round(self, server, clients):

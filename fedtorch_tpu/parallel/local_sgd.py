@@ -27,7 +27,8 @@ import numpy as np
 from fedtorch_tpu.algorithms.fedavg import FedAvg
 from fedtorch_tpu.config import ExperimentConfig
 from fedtorch_tpu.core.sync import local_steps_from_config
-from fedtorch_tpu.data.batching import ClientData, stack_partitions
+from fedtorch_tpu.data.batching import ClientData, pad_client_axis, \
+    stack_partitions
 from fedtorch_tpu.data.partition import iid_partition
 from fedtorch_tpu.models.common import ModelDef
 from fedtorch_tpu.parallel.federated import FederatedTrainer
@@ -120,7 +121,8 @@ class LocalSGDTrainer(FederatedTrainer):
         parts = iid_partition(len(labels), self.num_clients,
                               seed=epoch_seed)
         self.data = shard_clients(
-            stack_partitions(feats, labels, parts), self.mesh)
+            pad_client_axis(stack_partitions(feats, labels, parts),
+                            self.padded_clients), self.mesh)
 
     def fit(self, rng: jax.Array, callback=None):
         """Run until the stop criterion (distributed.py:107-120):
@@ -131,7 +133,7 @@ class LocalSGDTrainer(FederatedTrainer):
         history = []
         last_epoch_int = 0
         while True:
-            epoch = float(jnp.mean(clients.epoch))
+            epoch = self.mean_client_epoch(clients)
             it = int(jnp.max(clients.local_index))
             if cfg.train.stop_criteria == "iteration" \
                     and cfg.train.num_iterations is not None:

@@ -33,16 +33,29 @@ def init_multihost(cfg: MeshConfig) -> None:
 def make_mesh(cfg: MeshConfig, num_clients: Optional[int] = None) -> Mesh:
     """1-D mesh over all (or the first ``num_devices``) devices.
 
-    When ``num_clients`` is given, the device count is clamped to a divisor
-    of it so the client axis shards evenly (clients_per_device >= 1 —
-    SURVEY.md §7 'clients-per-core > 1' layout)."""
+    Every requested device is always used: when ``num_clients`` does not
+    divide the device count, the engine pads the client axis with inert
+    zero-weight clients (:func:`padded_client_count`) instead of idling
+    chips — SURVEY.md §7's ``[cores, clients_per_core]`` layout. The
+    ``num_clients`` argument is kept for API compatibility; it no longer
+    constrains the mesh."""
+    del num_clients  # padding, not divisor-clamping, handles remainders
     devices = jax.devices(cfg.backend) if cfg.backend else jax.devices()
     n = cfg.num_devices or len(devices)
     n = min(n, len(devices))
-    if num_clients is not None:
-        while num_clients % n:
-            n -= 1
     return Mesh(np.asarray(devices[:n]), (cfg.axis_name,))
+
+
+def padded_client_count(num_clients: int, mesh: Mesh) -> int:
+    """Smallest multiple of the mesh size >= ``num_clients``.
+
+    The gap is filled with padding clients that are never sampled by
+    ``participation_indices`` (which permutes only the REAL client range),
+    so they contribute zero FLOPs to training and zero weight to
+    aggregation — they exist purely so the client axis shards evenly over
+    all devices."""
+    n = int(mesh.devices.size)
+    return -(-num_clients // n) * n
 
 
 def client_sharding(mesh: Mesh) -> NamedSharding:

@@ -75,6 +75,13 @@ def _rekey(server):
     return server._replace(rng=jax.random.wrap_key_data(server.rng))
 
 
+def _strip_padding(clients, num_clients: int):
+    """Only the REAL client range is serialized: the padding tail
+    (pad_client_axis) depends on the device count of the run that wrote
+    the checkpoint, so keeping it would pin restores to that topology."""
+    return jax.tree.map(lambda x: x[:num_clients], clients)
+
+
 def save_checkpoint(directory: str, server, clients,
                     cfg: ExperimentConfig, best_prec1: float,
                     is_best: bool, save_all: bool = False,
@@ -82,7 +89,8 @@ def save_checkpoint(directory: str, server, clients,
     """Serialize the full round state (checkpoint.py:68-82 semantics)."""
     os.makedirs(directory, exist_ok=True)
     payload = serialization.to_bytes(
-        {"server": _unkey(server), "clients": clients})
+        {"server": _unkey(server),
+         "clients": _strip_padding(clients, cfg.federated.num_clients)})
     round_idx = int(server.round)
     path = os.path.join(directory, "checkpoint.ckpt")
     with open(path, "wb") as f:
@@ -136,8 +144,14 @@ def maybe_resume(directory: Optional[str], server, clients,
         raise ValueError(
             "Checkpoint incompatible: num_epochs must not shrink "
             f"({old['num_epochs']} -> {new['num_epochs']})")
+    C = cfg.federated.num_clients
     with open(path, "rb") as f:
         restored = serialization.from_bytes(
-            {"server": _unkey(server), "clients": clients}, f.read())
-    return (_rekey(restored["server"]), restored["clients"],
+            {"server": _unkey(server),
+             "clients": _strip_padding(clients, C)}, f.read())
+    # graft the restored real clients back into the (possibly padded)
+    # freshly-initialized template, preserving its sharding layout
+    new_clients = jax.tree.map(lambda full, real: full.at[:C].set(real),
+                               clients, restored["clients"])
+    return (_rekey(restored["server"]), new_clients,
             float(meta.get("best_prec1", 0.0)), True)

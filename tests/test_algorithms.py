@@ -134,6 +134,46 @@ class TestFedGateSemantics:
         np.testing.assert_allclose(np.asarray(new_aux["memory"]["w"]),
                                    [0.5, 0, 0, 0])
 
+    def test_quantized_downlink_requantizes_once(self):
+        """FedCOMGATE: aggregate_transform re-quantizes the aggregated
+        sum, and the values land on the quantization grid; server_update
+        itself no longer transforms (the engine applies the transform
+        once for BOTH server_update and client_post — the reference
+        broadcasts the re-quantized tensor, fedgate.py:74-79)."""
+        from fedtorch_tpu.ops.quantize import quantize_dequantize
+        cfg = _cfg("fedgate", quantized=True, quantized_bits=8)
+        alg = make_algorithm(cfg)
+        raw = {"w": jnp.linspace(-1.3, 2.7, 64)}
+        q = alg.aggregate_transform(raw)
+        np.testing.assert_allclose(
+            np.asarray(q["w"]),
+            np.asarray(quantize_dequantize(raw["w"], 8)), atol=1e-6)
+        assert not np.allclose(np.asarray(q["w"]), np.asarray(raw["w"]))
+
+    def test_engine_routes_transformed_sum_to_client_post(self):
+        """Monkeypatched aggregate_transform -> zeros must show up in
+        BOTH the server step (params unchanged) and the tracking update
+        (delta_track == delta_round/(lr*K)), proving the engine hands one
+        transformed sum to both consumers."""
+        trainer, _ = _trainer("fedgate")
+        alg = trainer.algorithm
+        alg.aggregate_transform = lambda ps: jax.tree.map(
+            jnp.zeros_like, ps)
+        server, clients = trainer.init_state(jax.random.key(0))
+        p0 = jax.tree.map(lambda x: np.asarray(x), server.params)
+        server2, clients2, _ = trainer.run_round(server, clients)
+        # zero sum -> server step is a no-op
+        for a, b in zip(jax.tree.leaves(p0),
+                        jax.tree.leaves(server2.params)):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       atol=1e-7)
+        # tracking consumed the SAME zero sum: delta_track must be
+        # nonzero (= delta_round/(lr*K), not (delta_round - raw_sum))
+        track = np.concatenate([
+            np.asarray(leaf).ravel()
+            for leaf in jax.tree.leaves(clients2.aux["delta"])])
+        assert np.abs(track).max() > 0
+
     @pytest.mark.parametrize("kw", [
         {},
         {"quantized": True, "quantized_bits": 8},     # FedCOMGATE

@@ -1,0 +1,173 @@
+"""Padded client axis: any num_clients must use ALL mesh devices.
+
+The reference maps clients to MPI processes 1:1 (utils/topology.py:57-114)
+so every client count trivially 'fits'; on a TPU mesh the client axis must
+shard evenly, which the engine guarantees by padding with inert clients
+(pad_client_axis) instead of idling devices. These tests pin:
+ * no idle devices for awkward client counts (6, 10, 100 on 8 devices) —
+   the north-star bench config is 100 clients;
+ * padding is numerically inert: the training trajectory is identical to
+   an unpadded single-device run;
+ * per-client evaluation summaries exclude the padding tail.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from fedtorch_tpu.algorithms import make_algorithm
+from fedtorch_tpu.config import (
+    DataConfig, ExperimentConfig, FederatedConfig, MeshConfig, ModelConfig,
+    OptimConfig, TrainConfig,
+)
+from fedtorch_tpu.data import build_federated_data
+from fedtorch_tpu.data.batching import pad_client_axis
+from fedtorch_tpu.models import define_model
+from fedtorch_tpu.parallel import (
+    FederatedTrainer, evaluate_clients, make_mesh, padded_client_count,
+)
+
+
+def _cfg(num_clients, num_devices, rate=1.0):
+    return ExperimentConfig(
+        data=DataConfig(dataset="synthetic", synthetic_dim=12,
+                        batch_size=8),
+        federated=FederatedConfig(federated=True, num_clients=num_clients,
+                                  online_client_rate=rate,
+                                  algorithm="fedavg",
+                                  sync_type="local_step"),
+        model=ModelConfig(arch="logistic_regression"),
+        optim=OptimConfig(lr=0.1, weight_decay=0.0),
+        train=TrainConfig(local_step=2),
+        mesh=MeshConfig(num_devices=num_devices),
+    ).finalize()
+
+
+def _build(num_clients, num_devices, rate=1.0):
+    cfg = _cfg(num_clients, num_devices, rate)
+    data = build_federated_data(cfg)
+    model = define_model(cfg, batch_size=8)
+    return FederatedTrainer(cfg, model, make_algorithm(cfg), data.train)
+
+
+@pytest.mark.parametrize("num_clients", [6, 10, 100])
+def test_no_idle_devices(num_clients):
+    """make_mesh must keep all 8 devices even when 8 does not divide C."""
+    assert len(jax.devices()) == 8, "conftest must force 8 CPU devices"
+    t = _build(num_clients, num_devices=8, rate=0.5)
+    assert t.mesh.devices.size == 8, (
+        f"{num_clients} clients idled devices: mesh={t.mesh.devices.size}")
+    assert t.padded_clients % 8 == 0
+    assert t.padded_clients >= num_clients
+
+    server, clients = t.init_state(jax.random.key(0))
+    leaf = jax.tree.leaves(clients.params)[0]
+    assert leaf.shape[0] == t.padded_clients
+    assert len(leaf.sharding.device_set) == 8, leaf.sharding
+
+    server, clients, metrics = t.run_round(server, clients)
+    jax.block_until_ready(server.params)
+    assert np.isfinite(float(metrics.train_loss.sum()))
+    # metrics stay on the REAL client axis
+    assert metrics.online_mask.shape == (num_clients,)
+
+
+def test_padding_count_helper():
+    mesh = make_mesh(MeshConfig(num_devices=8))
+    assert padded_client_count(6, mesh) == 8
+    assert padded_client_count(8, mesh) == 8
+    assert padded_client_count(10, mesh) == 16
+    assert padded_client_count(100, mesh) == 104
+
+
+@pytest.mark.parametrize("num_clients", [6, 10])
+def test_padding_numerically_inert(num_clients):
+    """Same seed, same config: the padded 8-device run must reproduce the
+    unpadded 1-device trajectory exactly (padding weight is zero)."""
+    t1 = _build(num_clients, num_devices=1)
+    t8 = _build(num_clients, num_devices=8)
+    assert t1.padded_clients == num_clients  # 1 device: no padding
+    assert t8.padded_clients % 8 == 0
+
+    s1, c1 = t1.init_state(jax.random.key(7))
+    s8, c8 = t8.init_state(jax.random.key(7))
+    for _ in range(3):
+        s1, c1, m1 = t1.run_round(s1, c1)
+        s8, c8, m8 = t8.run_round(s8, c8)
+    for a, b in zip(jax.tree.leaves(s1.params),
+                    jax.tree.leaves(s8.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(m1.train_loss),
+                               np.asarray(m8.train_loss), atol=1e-5)
+
+
+def test_partial_participation_never_selects_padding():
+    """With rate<1 the sampled indices must stay inside the real range."""
+    t = _build(10, num_devices=8, rate=0.3)
+    server, clients = t.init_state(jax.random.key(3))
+    for _ in range(5):
+        server, clients, metrics = t.run_round(server, clients)
+        mask = np.asarray(metrics.online_mask)
+        assert mask.shape == (10,)
+        assert mask.sum() == t.k_online
+    # the padding tail of the client state never left its init value
+    pad_epochs = np.asarray(clients.epoch)[10:]
+    assert np.all(pad_epochs == 0.0)
+
+
+def test_evaluate_clients_ignores_padding():
+    """Cross-client summaries must not include the inert padding tail."""
+    t = _build(6, num_devices=8)
+    server, clients = t.init_state(jax.random.key(1))
+    server, clients, _ = t.run_round(server, clients)
+    losses, accs, summary = evaluate_clients(
+        t.model, clients.params, t.data, batch_size=8, max_batches=2)
+    assert losses.shape[0] == t.padded_clients
+    real_accs = np.asarray(accs)[:6]
+    assert summary["acc_worst"] == pytest.approx(float(real_accs.min()))
+    assert summary["acc_best"] == pytest.approx(float(real_accs.max()))
+
+
+def test_local_sgd_stop_criterion_unbiased_by_padding():
+    """6 workers on 8 devices: the epoch-based stop must count only the
+    real workers, not the never-advancing padding tail (which would make
+    training overshoot the requested epoch count by padded/real)."""
+    from fedtorch_tpu.data import generate_synthetic
+    from fedtorch_tpu.parallel import build_local_sgd
+
+    cfg = ExperimentConfig(
+        data=DataConfig(dataset="synthetic", synthetic_dim=12,
+                        batch_size=10),
+        federated=FederatedConfig(federated=False, num_clients=6),
+        model=ModelConfig(arch="logistic_regression"),
+        optim=OptimConfig(lr=0.2, weight_decay=0.0),
+        train=TrainConfig(num_epochs=2, local_step=2),
+        mesh=MeshConfig(num_devices=8),
+    ).finalize()
+    d = generate_synthetic(num_tasks=4, alpha=0.0, beta=0.0, num_dim=12)
+    feats = np.concatenate(d.client_x)
+    labels = np.concatenate(d.client_y)
+    model = define_model(cfg, batch_size=10)
+    trainer = build_local_sgd(cfg, model, feats, labels)
+    assert trainer.padded_clients == 8 and trainer.num_clients == 6
+    server, clients, history = trainer.fit(jax.random.key(0))
+    real_epochs = np.asarray(clients.epoch)[:6]
+    # every real worker finished ~2 epochs, with at most one extra round
+    # of overshoot (rounds are local_step-sized)
+    assert real_epochs.min() >= 2.0
+    assert real_epochs.max() < 2.5
+    assert np.all(np.asarray(clients.epoch)[6:] == 0.0)
+
+
+def test_pad_client_axis_shapes():
+    from fedtorch_tpu.data.batching import ClientData
+    data = ClientData(x=jnp.ones((3, 5, 2)), y=jnp.ones((3, 5)),
+                      sizes=jnp.asarray([5, 4, 3], jnp.int32))
+    padded = pad_client_axis(data, 8)
+    assert padded.x.shape == (8, 5, 2)
+    assert padded.y.shape == (8, 5)
+    assert list(np.asarray(padded.sizes)) == [5, 4, 3, 0, 0, 0, 0, 0]
+    assert pad_client_axis(data, 3) is data
+    with pytest.raises(ValueError):
+        pad_client_axis(data, 2)

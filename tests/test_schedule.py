@@ -56,6 +56,64 @@ def test_multistep_matches_reference():
         assert float(lr_at(sched, e)) == pytest.approx(ref(e), rel=1e-6), e
 
 
+def test_multistep_warmup_matches_reference():
+    """Warmup PREPENDS a field: the base-LR plateau must survive from
+    warmup end (5) to the first change epoch (15) — learning.py:139-154.
+    Scale-up makes warmup ramp unscaled-lr -> scaled base lr."""
+    ref, args = _ref_scheduler(
+        lr_schedule_scheme="custom_multistep", lr_change_epochs="15,25",
+        lr_warmup=True, lr_warmup_epochs=5, init_warmup_lr=0.01,
+        learning_rate=0.1, num_epochs=40)
+    sched = compile_schedule(
+        LRConfig(schedule_scheme="custom_multistep",
+                 lr_change_epochs="15,25", decay=10.0, warmup=True,
+                 warmup_epochs=5, scaleup=True, scaleup_factor=10.0),
+        OptimConfig(lr=0.01), num_epochs=40)
+    for e in [0.0, 2.5, 4.99, 5.0, 9.0, 14.99, 15.0, 20.0, 25.0, 39.5]:
+        assert float(lr_at(sched, e)) == pytest.approx(ref(e),
+                                                       rel=1e-5), e
+
+
+def test_multistep_warmup_no_change_epochs_matches_reference():
+    """lr_change_epochs=None + warmup: two fields (ramp, then constant);
+    the LR must NOT keep increasing past warmup end."""
+    ref, args = _ref_scheduler(
+        lr_schedule_scheme="custom_multistep", lr_change_epochs=None,
+        lr_warmup=True, lr_warmup_epochs=5, init_warmup_lr=0.02,
+        learning_rate=0.2, num_epochs=30)
+    sched = compile_schedule(
+        LRConfig(schedule_scheme="custom_multistep", decay=10.0,
+                 warmup=True, warmup_epochs=5, scaleup=True,
+                 scaleup_factor=10.0),
+        OptimConfig(lr=0.02), num_epochs=30)
+    for e in [0.0, 2.5, 5.0, 10.0, 29.9]:
+        assert float(lr_at(sched, e)) == pytest.approx(ref(e),
+                                                       rel=1e-5), e
+    # the plateau holds the scaled base LR, no post-warmup growth
+    assert float(lr_at(sched, 29.0)) == pytest.approx(0.2, rel=1e-5)
+
+
+def test_multistep_warmup_overlapping_fields_first_match():
+    """warmup_epochs (10) past the first change epoch (5) produces
+    OVERLAPPING fields; the reference's sequential fall_in returns the
+    first match, never a sum of matches."""
+    ref, args = _ref_scheduler(
+        lr_schedule_scheme="custom_multistep", lr_change_epochs="5,15",
+        lr_warmup=True, lr_warmup_epochs=10, init_warmup_lr=0.1,
+        learning_rate=0.1, num_epochs=30)
+    sched = compile_schedule(
+        LRConfig(schedule_scheme="custom_multistep",
+                 lr_change_epochs="5,15", decay=10.0, warmup=True,
+                 warmup_epochs=10),
+        OptimConfig(lr=0.1), num_epochs=30)
+    for e in [0.0, 4.0, 6.0, 9.5, 10.0, 14.9, 15.0, 29.9]:
+        assert float(lr_at(sched, e)) == pytest.approx(ref(e),
+                                                       rel=1e-5), e
+    # in the overlap window first-match = warmup field, and the value
+    # must never exceed the larger of the overlapping fields
+    assert float(lr_at(sched, 7.0)) == pytest.approx(0.1, rel=1e-5)
+
+
 def test_onecycle_matches_reference():
     ref, args = _ref_scheduler(lr_schedule_scheme="custom_one_cycle",
                                num_epochs=60)
