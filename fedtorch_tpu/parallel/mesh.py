@@ -15,6 +15,7 @@ from __future__ import annotations
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -67,12 +68,30 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def _put(x, sh: NamedSharding):
+    """Multihost-aware placement: ``device_put`` only accepts fully
+    addressable shardings, so on a multi-process (DCN) mesh the global
+    array is assembled from each process's slice of the host data. Every
+    process holds identical host data (the shared-seed determinism
+    contract, docs/multihost.md), so the local slice is just a view."""
+    if sh.is_fully_addressable:
+        return jax.device_put(x, sh)
+    dt = getattr(x, "dtype", None)
+    if dt is not None and jnp.issubdtype(dt, jax.dtypes.prng_key):
+        # typed PRNG keys can't round-trip through numpy; carry the raw
+        # key data (the spec applies to leading axes, so the trailing
+        # key-word dimension is unaffected)
+        data = _put(jax.random.key_data(x), sh)
+        return jax.random.wrap_key_data(data, impl=jax.random.key_impl(x))
+    return jax.make_array_from_process_local_data(sh, np.asarray(x))
+
+
 def shard_clients(tree, mesh: Mesh):
     """Place a [C, ...] pytree with the client axis split over devices."""
     sh = client_sharding(mesh)
-    return jax.tree.map(lambda x: jax.device_put(x, sh), tree)
+    return jax.tree.map(lambda x: _put(x, sh), tree)
 
 
 def replicate(tree, mesh: Mesh):
     sh = replicated_sharding(mesh)
-    return jax.tree.map(lambda x: jax.device_put(x, sh), tree)
+    return jax.tree.map(lambda x: _put(x, sh), tree)

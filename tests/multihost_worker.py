@@ -1,0 +1,71 @@
+"""Worker process for the multi-host smoke test (test_multihost.py).
+
+Each of the two processes owns 4 virtual CPU devices; together they form
+an 8-device global mesh over which one federated round executes — the
+DCN analog of the reference's ``dist.init_process_group('mpi')`` bring-up
+(main.py:17). Run as:
+
+    python tests/multihost_worker.py <port> <process_id>
+"""
+import os
+import sys
+
+port, pid = sys.argv[1], int(sys.argv[2])
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # keep sitecustomize off TPU
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from fedtorch_tpu.algorithms import make_algorithm  # noqa: E402
+from fedtorch_tpu.config import (  # noqa: E402
+    DataConfig, ExperimentConfig, FederatedConfig, MeshConfig, ModelConfig,
+    OptimConfig, TrainConfig,
+)
+from fedtorch_tpu.data import build_federated_data  # noqa: E402
+from fedtorch_tpu.models import define_model  # noqa: E402
+from fedtorch_tpu.parallel import FederatedTrainer, init_multihost  # noqa: E402
+
+mesh_cfg = MeshConfig(coordinator_address=f"localhost:{port}",
+                      num_processes=2, process_id=pid)
+init_multihost(mesh_cfg)
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 8, jax.devices()
+assert len(jax.local_devices()) == 4
+
+cfg = ExperimentConfig(
+    data=DataConfig(dataset="synthetic", synthetic_dim=12, batch_size=8),
+    federated=FederatedConfig(federated=True, num_clients=10,
+                              online_client_rate=1.0, algorithm="fedavg",
+                              sync_type="local_step"),
+    model=ModelConfig(arch="logistic_regression"),
+    optim=OptimConfig(lr=0.1, weight_decay=0.0),
+    train=TrainConfig(local_step=2),
+    mesh=mesh_cfg,
+).finalize()
+# every process derives identical data/partitions from the shared seed —
+# the determinism contract that replaces the reference's rank-0 broadcast
+# (partition.py:25-33; docs/multihost.md 'Determinism across hosts')
+data = build_federated_data(cfg)
+model = define_model(cfg, batch_size=cfg.data.batch_size)
+trainer = FederatedTrainer(cfg, model, make_algorithm(cfg), data.train)
+assert trainer.mesh.devices.size == 8
+assert trainer.padded_clients == 16  # 10 clients padded over 8 devices
+
+server, clients = trainer.init_state(jax.random.key(0))
+leaf = jax.tree.leaves(clients.params)[0]
+assert len(leaf.sharding.device_set) == 8, leaf.sharding
+
+for _ in range(2):
+    server, clients, metrics = trainer.run_round(server, clients)
+jax.block_until_ready(server.params)
+
+# replicated scalars are fetchable on every host
+loss = float(metrics.train_loss.sum()) / 10.0
+epoch = trainer.mean_client_epoch(clients)
+assert loss == loss and epoch > 0, (loss, epoch)
+print(f"MULTIHOST_OK pid={pid} loss={loss:.6f} epoch={epoch:.3f}",
+      flush=True)
+jax.distributed.shutdown()
