@@ -31,14 +31,6 @@ from fedtorch_tpu.core import optim
 class APFL(FedAvg):
     name = "apfl"
 
-    def bind(self, model, criterion):
-        super().bind(model, criterion)
-        if model.is_recurrent:
-            raise NotImplementedError(
-                "apfl does not support recurrent models (the reference's "
-                "inference_personal, eval.py:31-39, has no hidden-state "
-                "handling either)")
-
     def init_client_aux(self, params):
         return {
             "personal": jax.tree.map(jnp.array, params),
@@ -52,9 +44,12 @@ class APFL(FedAvg):
 
     def _mixed_loss(self, personal_params, local_params, alpha, bx, by,
                     rng):
+        # recurrent models run with a fresh zero carry per batch
+        # (forward_reset policy; base.py)
         train = rng is not None
-        out_p = self.model.apply(personal_params, bx, train=train, rng=rng)
-        out_l = self.model.apply(local_params, bx, train=train, rng=rng)
+        out_p = self.forward_reset(personal_params, bx, train=train,
+                                   rng=rng)
+        out_l = self.forward_reset(local_params, bx, train=train, rng=rng)
         return self.criterion(alpha * out_p + (1 - alpha) * out_l, by)
 
     def pre_round(self, on_aux, *, server, x, y, sizes, lr, rng):

@@ -76,6 +76,22 @@ class FedAlgorithm:
         return ()
 
     # -- local loop hooks (per client, inside the scan) ------------------
+    def forward_reset(self, params, bx, *, train: bool = False, rng=None):
+        """Forward pass with a FRESH zero hidden carry for recurrent
+        models — the policy for every AUXILIARY forward (personal models,
+        MAML outer steps, DRFA's kth-model loss probe). The reference
+        re-inits hidden per round for its main loop
+        (centered/main.py:96-97) and starts auxiliary inferences fresh
+        (centered/drfa.py:242); only the engine's main local loop threads
+        a carry across steps."""
+        model = self.model
+        if model.is_recurrent:
+            logits, _ = model.apply(
+                params, bx, train=train, rng=rng,
+                carry=model.init_carry(bx.shape[0]))
+            return logits
+        return model.apply(params, bx, train=train, rng=rng)
+
     def extra_loss(self, params, server_params, client_aux) -> jnp.ndarray:
         """Added to the batch loss (FedProx's proximal term)."""
         return jnp.asarray(0.0)

@@ -53,8 +53,6 @@ class DRFA(FedAlgorithm):
     def bind(self, model, criterion):
         super().bind(model, criterion)
         self.inner.bind(model, criterion)
-        if model.is_recurrent:
-            raise NotImplementedError("drfa does not support rnn models")
 
     # -- state -------------------------------------------------------------
     def init_client_aux(self, params):
@@ -167,7 +165,8 @@ class DRFA(FedAlgorithm):
         def one_loss(ci, rng_c):
             x, y = data.x[ci], data.y[ci]
             bx, by = sample_batch(rng_c, x, y, data.sizes[ci], B)
-            logits = model.apply(kth_avg, bx)
+            # fresh hidden for the kth-model probe (centered/drfa.py:242)
+            logits = self.forward_reset(kth_avg, bx)
             return jnp.mean(per_sample_loss(logits, by,
                                             model.is_regression))
 

@@ -20,12 +20,6 @@ class PerFedAvg(FedAvg):
     name = "perfedavg"
     needs_val_batch = True
 
-    def bind(self, model, criterion):
-        super().bind(model, criterion)
-        if model.is_recurrent:
-            raise NotImplementedError(
-                "perfedavg does not support recurrent models")
-
     def init_client_aux(self, params):
         # pre-aggregation adapted model — the personalized artifact
         return {"local_snapshot": jax.tree.map(jnp.array, params)}
@@ -54,7 +48,9 @@ class PerFedAvg(FedAvg):
         rng_v = jax.random.fold_in(rng, 2)
 
         def vloss(p):
-            logits = self.model.apply(p, bval_x, train=True, rng=rng_v)
+            # the reference's outer inference threads no hidden state
+            # (centered/main.py:166); fresh zero carry for rnn archs
+            logits = self.forward_reset(p, bval_x, train=True, rng=rng_v)
             return self.criterion(logits, bval_y)
 
         g = jax.grad(vloss)(params)

@@ -31,12 +31,6 @@ from fedtorch_tpu.core.losses import accuracy
 class PerFedMe(FedAvg):
     name = "perfedme"
 
-    def bind(self, model, criterion):
-        super().bind(model, criterion)
-        if model.is_recurrent:
-            raise NotImplementedError(
-                "perfedme does not support recurrent models")
-
     def init_client_aux(self, params):
         return {
             "personal": jax.tree.map(jnp.array, params),
@@ -50,7 +44,8 @@ class PerFedMe(FedAvg):
         model, criterion = self.model, self.criterion
 
         def ploss(pp):
-            logits = model.apply(pp, bx, train=True, rng=rng)
+            # personal model: fresh zero carry per batch for rnn archs
+            logits = self.forward_reset(pp, bx, train=True, rng=rng)
             return criterion(logits, by), logits
 
         (loss, logits), g_p = jax.value_and_grad(ploss, has_aux=True)(

@@ -25,6 +25,16 @@ class EvalResult(NamedTuple):
     top5: jnp.ndarray
 
 
+def forward_fn(model: ModelDef):
+    """``(params, x) -> logits`` for any model: recurrent models get a
+    fresh zero hidden carry per call (the shared policy for evaluation
+    and auxiliary forwards — see FedAlgorithm.forward_reset)."""
+    if model.is_recurrent:
+        return lambda p, x: model.apply(
+            p, x, carry=model.init_carry(x.shape[0]))[0]
+    return lambda p, x: model.apply(p, x)
+
+
 def _pad_batches(x: np.ndarray, y: np.ndarray, batch_size: int):
     n = x.shape[0]
     n_batches = max((n + batch_size - 1) // batch_size, 1)
@@ -166,11 +176,7 @@ def evaluate_clients(model: ModelDef, client_params, data,
     n_b = min(max_batches, max(data.n_max // batch_size, 1))
 
     if apply_fn is None:
-        if model.is_recurrent:
-            apply_fn = lambda p, x: model.apply(
-                p, x, carry=model.init_carry(x.shape[0]))[0]
-        else:
-            apply_fn = lambda p, x: model.apply(p, x)
+        apply_fn = forward_fn(model)
 
     @jax.jit
     def run(client_params, data):
@@ -271,8 +277,9 @@ def evaluate_personal(model: ModelDef, client_aux, client_params, data,
     if algorithm_name == "apfl":
         eval_params = (client_aux["personal"],
                        client_aux["local_snapshot"], client_aux["alpha"])
-        apply_fn = lambda ps, x: ps[2] * model.apply(ps[0], x) \
-            + (1 - ps[2]) * model.apply(ps[1], x)
+        fwd = forward_fn(model)
+        apply_fn = lambda ps, x: ps[2] * fwd(ps[0], x) \
+            + (1 - ps[2]) * fwd(ps[1], x)
     elif algorithm_name == "perfedme":
         eval_params = client_aux["personal"]
         apply_fn = None

@@ -1,0 +1,124 @@
+"""Recurrent models through the full federated engine.
+
+The reference's centered loops run the Shakespeare GRU for the fedavg
+family, AFL, and DRFA with a per-round hidden re-init
+(centered/main.py:96-97, centered/drfa.py:94-95); auxiliary inferences
+start from a fresh hidden (centered/drfa.py:242). These tests pin the
+engine's rnn-carry threading plus every algorithm that runs its own
+forwards (APFL, PerFedMe, PerFedAvg, DRFA) on a char-level token task.
+"""
+import numpy as np
+import jax
+import pytest
+
+from fedtorch_tpu.algorithms import make_algorithm
+from fedtorch_tpu.config import (
+    DataConfig, ExperimentConfig, FederatedConfig, MeshConfig, ModelConfig,
+    OptimConfig, TrainConfig,
+)
+from fedtorch_tpu.data.batching import ClientData
+from fedtorch_tpu.models import define_model
+from fedtorch_tpu.parallel import FederatedTrainer, evaluate_personal
+
+VOCAB, SEQ, C, N = 12, 10, 4, 24
+
+
+def _token_data(seed=0, n=N, num_clients=C):
+    """Tiny shakespeare-shaped dataset: next-token targets on a cyclic
+    alphabet, so the GRU has learnable structure."""
+    rng = np.random.RandomState(seed)
+    starts = rng.randint(0, VOCAB, size=(num_clients, n, 1))
+    seq = (starts + np.arange(SEQ + 1)[None, None, :]) % VOCAB
+    x = seq[..., :-1].astype(np.int32)
+    y = seq[..., 1:].astype(np.int32)
+    sizes = np.full((num_clients,), n, np.int32)
+    return ClientData(x=x, y=y, sizes=sizes)
+
+
+def _cfg(algorithm, **fed_kw):
+    return ExperimentConfig(
+        data=DataConfig(dataset="shakespeare", batch_size=6),
+        federated=FederatedConfig(federated=True, num_clients=C,
+                                  online_client_rate=1.0,
+                                  algorithm=algorithm,
+                                  sync_type="local_step", **fed_kw),
+        model=ModelConfig(arch="rnn", vocab_size=VOCAB, rnn_seq_len=SEQ,
+                          rnn_hidden_size=16),
+        optim=OptimConfig(lr=0.3, weight_decay=0.0),
+        train=TrainConfig(local_step=4),
+        mesh=MeshConfig(num_devices=1),
+    ).finalize()
+
+
+def _trainer(algorithm, **fed_kw):
+    cfg = _cfg(algorithm, **fed_kw)
+    model = define_model(cfg, batch_size=cfg.data.batch_size)
+    data = _token_data()
+    val = _token_data(seed=1, n=8) if fed_kw.get("personal") else None
+    return FederatedTrainer(cfg, model, make_algorithm(cfg), data,
+                            val_data=val)
+
+
+def test_fedavg_rnn_round_learns():
+    """Engine carry threading: loss must drop on the cyclic-token task."""
+    t = _trainer("fedavg")
+    server, clients = t.init_state(jax.random.key(0))
+    first = last = None
+    for _ in range(10):
+        server, clients, m = t.run_round(server, clients)
+        loss = float(m.train_loss.sum()) / C
+        if first is None:
+            first = loss
+        last = loss
+    assert np.isfinite(last)
+    assert last < first * 0.8, (first, last)
+
+
+@pytest.mark.parametrize("algorithm,fed_kw", [
+    ("apfl", {"personal": True}),
+    ("perfedme", {"personal": True}),
+    ("perfedavg", {"personal": True}),
+    ("afl", {}),
+    ("fedavg", {"drfa": True}),
+])
+def test_rnn_supported_across_algorithms(algorithm, fed_kw):
+    """Every formerly-restricted algorithm must run the GRU end to end
+    with finite losses (VERDICT r1 item 8)."""
+    t = _trainer(algorithm, **fed_kw)
+    server, clients = t.init_state(jax.random.key(1))
+    for _ in range(3):
+        server, clients, m = t.run_round(server, clients)
+    loss = float(m.train_loss.sum()) / C
+    assert np.isfinite(loss), (algorithm, loss)
+
+
+def test_apfl_rnn_personal_evaluation():
+    """The mixed personal/local inference must handle the hidden carry."""
+    t = _trainer("apfl", personal=True)
+    server, clients = t.init_state(jax.random.key(2))
+    server, clients, _ = t.run_round(server, clients)
+    losses, accs, summary = evaluate_personal(
+        t.model, clients.aux, clients.params, t.val_data, "apfl",
+        batch_size=6, max_batches=2)
+    assert np.isfinite(summary["loss_mean"])
+    assert 0.0 <= summary["acc_mean"] <= 1.0
+
+
+def test_rnn_carry_not_persisted_in_client_state():
+    """The hidden carry is rebuilt from zeros INSIDE each round program
+    (federated.py carry0 = init_carry); ClientState has no slot that
+    could persist it across rounds — which is exactly the reference's
+    per-round init_hidden semantics (centered/main.py:96-97)."""
+    from fedtorch_tpu.core.state import ClientState as CS
+
+    assert CS._fields == ("params", "opt", "aux", "epoch", "local_index")
+    t = _trainer("fedavg")
+    server, clients = t.init_state(jax.random.key(3))
+    carry_shape = tuple(np.shape(t.model.init_carry(t.batch_size)))
+    for leaf in jax.tree.leaves(clients):
+        # no per-client leaf is carry-shaped (would mean a stored hidden)
+        assert tuple(leaf.shape)[1:] != carry_shape, leaf.shape
+    # round execution preserves that structure
+    server, clients2, _ = t.run_round(server, clients)
+    _, fresh = t.init_state(jax.random.key(3))
+    assert jax.tree.structure(clients2) == jax.tree.structure(fresh)
