@@ -86,14 +86,14 @@ class APFL(FedAvg):
 
     def local_step(self, *, params, opt, client_aux, rnn_carry,
                    server_params, server_aux, bx, by, bval_x, bval_y, lr,
-                   rng, step_idx, local_index):
+                   rng, step_idx, local_index, step_budget=None):
         # 1) standard local step (apfl.py:95-103)
         params, opt, client_aux, rnn_carry, loss, acc = super().local_step(
             params=params, opt=opt, client_aux=client_aux,
             rnn_carry=rnn_carry, server_params=server_params,
             server_aux=server_aux, bx=bx, by=by, bval_x=bval_x,
             bval_y=bval_y, lr=lr, rng=rng, step_idx=step_idx,
-            local_index=local_index)
+            local_index=local_index, step_budget=step_budget)
         # 2) personal step on the mixed output with the UPDATED local
         #    model (apfl.py:105-116)
         alpha = client_aux["alpha"]

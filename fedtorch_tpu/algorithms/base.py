@@ -127,11 +127,17 @@ class FedAlgorithm:
 
     def local_step(self, *, params, opt, client_aux, rnn_carry,
                    server_params, server_aux, bx, by, bval_x, bval_y, lr,
-                   rng, step_idx, local_index):
+                   rng, step_idx, local_index, step_budget=None):
         """One local training step (the hot loop body,
         federated/main.py:83-155). The base implements the standard
         inference -> backward -> per-algorithm grad correction ->
         dual-mode SGD step; personalized algorithms override or extend.
+
+        ``step_budget`` is the client's EFFECTIVE step count this round
+        (its epoch-sync budget; == the scan length in local-step mode):
+        steps at index >= step_budget run but are masked out by the
+        engine, so step-indexed logic (sync pulls, snapshots) must
+        anchor on the budget, not the scan length.
 
         Returns (params, opt, client_aux, rnn_carry, loss, acc)."""
         model, criterion, cfg = self.model, self.criterion, self.cfg

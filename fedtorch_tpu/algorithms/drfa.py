@@ -98,16 +98,24 @@ class DRFA(FedAlgorithm):
 
     def local_step(self, *, params, opt, client_aux, rnn_carry,
                    server_params, server_aux, bx, by, bval_x, bval_y, lr,
-                   rng, step_idx, local_index):
+                   rng, step_idx, local_index, step_budget=None):
         params, opt, inner_aux, rnn_carry, loss, acc = \
             self.inner.local_step(
                 params=params, opt=opt, client_aux=client_aux["inner"],
                 rnn_carry=rnn_carry, server_params=server_params,
                 server_aux=server_aux["inner"], bx=bx, by=by,
                 bval_x=bval_x, bval_y=bval_y, lr=lr, rng=rng,
-                step_idx=step_idx, local_index=local_index)
-        # snapshot after k local steps (drfa.py:109-111)
-        hit = (step_idx + 1) == client_aux["k_rand"]
+                step_idx=step_idx, local_index=local_index,
+                step_budget=step_budget)
+        # snapshot after k local steps (drfa.py:109-111); under
+        # epoch-sync size skew the shared k is clamped into the client's
+        # own active range so an early-exited client still snapshots a
+        # REAL model (the reference's DRFA "does not fully support the
+        # epoch mode", drfa.py:96 — this is the faithful generalization)
+        k_snap = client_aux["k_rand"] if step_budget is None \
+            else jnp.minimum(client_aux["k_rand"],
+                             jnp.asarray(step_budget, jnp.int32))
+        hit = (step_idx + 1) == k_snap
         kth = jax.tree.map(lambda s, p: jnp.where(hit, p, s),
                            client_aux["kth"], params)
         new_aux = dict(client_aux, inner=inner_aux, kth=kth)

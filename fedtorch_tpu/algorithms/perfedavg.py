@@ -34,14 +34,14 @@ class PerFedAvg(FedAvg):
 
     def local_step(self, *, params, opt, client_aux, rnn_carry,
                    server_params, server_aux, bx, by, bval_x, bval_y, lr,
-                   rng, step_idx, local_index):
+                   rng, step_idx, local_index, step_budget=None):
         # inner step (centered/main.py:127-141 standard step)
         params, opt, client_aux, rnn_carry, loss, acc = super().local_step(
             params=params, opt=opt, client_aux=client_aux,
             rnn_carry=rnn_carry, server_params=server_params,
             server_aux=server_aux, bx=bx, by=by, bval_x=bval_x,
             bval_y=bval_y, lr=lr, rng=rng, step_idx=step_idx,
-            local_index=local_index)
+            local_index=local_index, step_budget=step_budget)
 
         # outer step at beta on the val batch (centered/main.py:156-170)
         beta = self.cfg.federated.perfedavg_beta

@@ -39,7 +39,7 @@ class PerFedMe(FedAvg):
 
     def local_step(self, *, params, opt, client_aux, rnn_carry,
                    server_params, server_aux, bx, by, bval_x, bval_y, lr,
-                   rng, step_idx, local_index):
+                   rng, step_idx, local_index, step_budget=None):
         lam = self.cfg.federated.perfedme_lambda
         model, criterion = self.model, self.criterion
 
@@ -57,9 +57,13 @@ class PerFedMe(FedAvg):
             client_aux["personal"], g_p, client_aux["personal_opt"], lr,
             self.cfg.optim)
 
-        # every 5 steps or at sync (= last step of the round,
-        # perfedme.py:115-124): pull w toward theta
-        is_last = step_idx == self.local_steps_per_round - 1
+        # every 5 steps or at sync (= the client's OWN last active step,
+        # perfedme.py:115-124 fires where the reference's local loop
+        # exits — under epoch-sync size skew that is the client's budget,
+        # not the scan length): pull w toward theta
+        last_step = step_budget if step_budget is not None \
+            else self.local_steps_per_round
+        is_last = (step_idx + 1) == last_step
         update_w = ((local_index + 1) % 5 == 0) | is_last
         g_w = jax.tree.map(lambda w, p: lam * (w - p), params, personal)
         new_params, new_opt = optim.local_step(params, g_w, opt, lr,

@@ -17,10 +17,14 @@ Design (SURVEY.md §7):
   by index each round (the reference's per-round ``new_group`` of online
   clients, main.py:61-65), so offline clients cost zero FLOPs. Round 0
   forces client 0 online (main.py:62-63).
-* The local loop is a fixed-length ``lax.scan`` (K steps). Epoch-sync mode
-  converts epochs -> steps exactly like the centered runtime
-  (nodes_centered.py:47-50); heterogeneous client sizes wrap cyclically
-  within the round instead of the reference's per-client early loop exit.
+* The local loop is a fixed-length ``lax.scan`` (K steps), sized for the
+  LARGEST client (nodes_centered.py:47-50 epochs -> steps). Epoch-sync
+  mode reproduces the reference's per-client early loop exit
+  (flow_utils.py:33-40 ``is_sync_fed``) by masking: a client whose own
+  epoch budget ``ceil(size/B)*E`` is exhausted keeps executing scan steps
+  in lockstep but its params/opt/aux/counters freeze and its metrics stop
+  accumulating — so under heavy size skew every client takes exactly the
+  reference's number of effective steps.
 * Aggregation: payloads are weighted client-side (fedavg.py:18-34
   delta-as-grad with rank weights) and tree-summed over the client axis —
   a ``psum``-shaped reduction XLA lowers onto ICI. Every device applies
@@ -88,13 +92,14 @@ class FederatedTrainer:
             int(cfg.federated.online_client_rate * self.num_clients), 1)
 
         # static local-step count per round (flow_utils.py:33-40 epoch /
-        # local_step sync modes; epoch mode uses the max client size so
-        # every client completes its epochs — shorter clients wrap)
+        # local_step sync modes; epoch mode sizes the scan for the max
+        # client — shorter clients early-exit via masking in round_fn)
         if cfg.federated.sync_type == "epoch":
             nb_max = math.ceil(data.n_max / self.batch_size)
             self.local_steps = nb_max * cfg.federated.num_epochs_per_comm
         else:
             self.local_steps = max(cfg.train.local_step, 1)
+        self.epoch_sync = cfg.federated.sync_type == "epoch"
 
         # 'batch' gathers only the K*B rows each online client will touch
         # this round (bounds cross-device movement when K*B < shard
@@ -295,8 +300,22 @@ class FederatedTrainer:
                                                              VAL_FOLD),
                                           vsize, vx.shape[0])
 
+            # per-client early exit (is_sync_fed, flow_utils.py:33-40):
+            # in epoch-sync mode a client stops after ITS OWN epoch
+            # budget ceil(size/B)*E steps; the scan keeps running in
+            # lockstep but frozen clients' state and metrics don't move.
+            # The budget is ALSO every hook's effective local_steps (so
+            # scaffold/fedgate control updates divide by the steps the
+            # client actually took) and feeds step-indexed algorithm
+            # logic (PerFedMe's sync pull, DRFA's snapshot clamp).
+            step_budget = (nb.astype(jnp.int32)
+                           * self.cfg.federated.num_epochs_per_comm) \
+                if self.epoch_sync else jnp.asarray(K, jnp.int32)
+
             def step(carry, k):
                 params, opt, aux, epoch, li, rnn_carry = carry
+                active = (k < step_budget) if self.epoch_sync \
+                    else jnp.asarray(True)
                 lr = lr_at(self.schedule, epoch)
                 if batch_mode:
                     bx = jax.lax.dynamic_slice_in_dim(x, k * B, B)
@@ -320,18 +339,25 @@ class FederatedTrainer:
                     bx = augment_image_batch(
                         jax.random.fold_in(aug_parent, k), bx)
                 drop_rng = jax.random.fold_in(rng_c, k + 1)
-                params, opt, aux, rnn_carry, loss, acc = alg.local_step(
+                n_params, n_opt, n_aux, n_rnn, loss, acc = alg.local_step(
                     params=params, opt=opt, client_aux=aux,
                     rnn_carry=rnn_carry, server_params=server_params,
                     server_aux=server.aux, bx=bx, by=by, bval_x=bval_x,
                     bval_y=bval_y, lr=lr, rng=drop_rng, step_idx=k,
-                    local_index=li)
-                return (params, opt, aux, epoch + 1.0 / nb, li + 1,
-                        rnn_carry), (loss, acc)
+                    local_index=li, step_budget=step_budget)
+                if self.epoch_sync:
+                    sel = lambda n, o: jax.tree.map(
+                        lambda a, b: jnp.where(active, a, b), n, o)
+                    n_params, n_opt = sel(n_params, params), sel(n_opt, opt)
+                    n_aux, n_rnn = sel(n_aux, aux), sel(n_rnn, rnn_carry)
+                af = active.astype(jnp.float32)
+                return (n_params, n_opt, n_aux, epoch + af / nb,
+                        li + active.astype(li.dtype), n_rnn), \
+                    (loss, acc, af)
 
             init = (server_params, cstate.opt, cstate.aux, cstate.epoch,
                     cstate.local_index, carry0)
-            (params, opt, aux, epoch, li, _), (losses, accs) = \
+            (params, opt, aux, epoch, li, _), (losses, accs, act) = \
                 jax.lax.scan(step, init, jnp.arange(K))
 
             delta = tree_sub(server_params, params)
@@ -339,12 +365,15 @@ class FederatedTrainer:
             payload, aux = alg.client_payload(
                 delta=delta, client_aux=aux, params=params,
                 server_params=server_params, server_aux=server.aux,
-                lr=lr_end, local_steps=K, weight=weight,
+                lr=lr_end, local_steps=step_budget, weight=weight,
                 full_loss=full_loss)
             new_state = ClientState(params=params, opt=opt, aux=aux,
                                     epoch=epoch, local_index=li)
-            return payload, delta, new_state, (jnp.mean(losses),
-                                               jnp.mean(accs))
+            # metrics over the steps the client actually took (frozen
+            # early-exit steps contribute nothing)
+            n_act = jnp.maximum(jnp.sum(act), 1.0)
+            return payload, delta, new_state, (
+                jnp.sum(losses * act) / n_act, jnp.sum(accs * act) / n_act)
 
         payloads, deltas, new_on_clients, (losses, accs) = jax.vmap(
             client_round)(on_clients, on_x, on_y, on_vx, on_vy, on_sizes,
@@ -362,14 +391,20 @@ class FederatedTrainer:
             client_losses=losses)
 
         # aux updates that need the aggregated payload (FedGATE); each
-        # client sees its own end-of-round local params and final LR
+        # client sees its own end-of-round local params, final LR, and
+        # EFFECTIVE step count (its epoch-sync budget, not the scan K)
+        if self.epoch_sync:
+            E = self.cfg.federated.num_epochs_per_comm
+            on_budgets = jnp.ceil(on_sizes / B).astype(jnp.int32) * E
+        else:
+            on_budgets = jnp.full(on_sizes.shape, K, jnp.int32)
         post_aux = jax.vmap(
-            lambda d, a, w, p, e: alg.client_post(
+            lambda d, a, w, p, e, ks: alg.client_post(
                 delta=d, client_aux=a, payload_sum=payload_sum,
-                lr=lr_at(self.schedule, e), local_steps=K,
+                lr=lr_at(self.schedule, e), local_steps=ks,
                 server_params=server.params, params=p, weight=w)
         )(deltas, new_on_clients.aux, weights, new_on_clients.params,
-          new_on_clients.epoch)
+          new_on_clients.epoch, on_budgets)
         new_on_clients = new_on_clients._replace(
             aux=post_aux,
             # clients leave the round holding the aggregated server model

@@ -1,0 +1,231 @@
+"""Epoch-sync semantics under heavy client-size skew (100:1).
+
+The reference's epoch-sync mode stops each client after ITS OWN epoch
+budget (``is_sync_fed``, flow_utils.py:33-40): a client with 4 samples
+and batch 4 takes exactly 1 step per round while a 400-sample client
+takes 100. The engine sizes its lax.scan for the largest client and
+early-exits the rest by masking; these tests pin that the masked
+trajectory is STEP-FOR-STEP the reference behavior, not a wrap-around
+approximation.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from fedtorch_tpu.algorithms import make_algorithm
+from fedtorch_tpu.config import (
+    DataConfig, ExperimentConfig, FederatedConfig, MeshConfig, ModelConfig,
+    OptimConfig, TrainConfig,
+)
+from fedtorch_tpu.core.losses import make_criterion
+from fedtorch_tpu.data.batching import ClientData
+from fedtorch_tpu.models import define_model
+from fedtorch_tpu.parallel import FederatedTrainer
+
+DIM, B = 8, 4
+
+
+def _skewed_data(sizes=(4, 400), seed=0):
+    """ClientData with a 100:1 size skew, padded to n_max rows."""
+    rng = np.random.RandomState(seed)
+    n_max = max(sizes)
+    xs, ys = [], []
+    for s in sizes:
+        x = rng.randn(s, DIM).astype(np.float32)
+        y = rng.randint(0, 10, size=s)
+        reps = -(-n_max // s)
+        xs.append(np.tile(x, (reps, 1))[:n_max])
+        ys.append(np.tile(y, reps)[:n_max])
+    return ClientData(x=np.stack(xs), y=np.stack(ys).astype(np.int32),
+                      sizes=np.asarray(sizes, np.int32))
+
+
+def _trainer(sizes, rate, algorithm="fedavg", **fed_kw):
+    cfg = ExperimentConfig(
+        data=DataConfig(dataset="synthetic", synthetic_dim=DIM,
+                        batch_size=B),
+        federated=FederatedConfig(federated=True, num_clients=len(sizes),
+                                  online_client_rate=rate,
+                                  algorithm=algorithm, sync_type="epoch",
+                                  num_epochs_per_comm=1, **fed_kw),
+        model=ModelConfig(arch="logistic_regression"),
+        optim=OptimConfig(lr=0.1, weight_decay=0.0, in_momentum=False),
+        train=TrainConfig(),
+        mesh=MeshConfig(num_devices=1),
+    ).finalize()
+    model = define_model(cfg, batch_size=B)
+    data = _skewed_data(sizes)
+    return FederatedTrainer(cfg, model, make_algorithm(cfg), data), data
+
+
+def test_short_client_takes_exactly_one_reference_step():
+    """Round 0 forces client 0 (the 4-sample client) online alone; with
+    weight 1 the new server model must equal EXACTLY one SGD step on its
+    full 4-sample batch — the reference's early-exit trajectory — even
+    though the scan runs 100 lockstep iterations."""
+    t, data = _trainer(sizes=(4, 400), rate=0.5)  # k_online = 1
+    assert t.local_steps == 100  # scan sized for the large client
+    server, clients = t.init_state(jax.random.key(0))
+    p0 = jax.tree.map(np.asarray, server.params)
+
+    criterion = make_criterion(False)
+    bx = jnp.asarray(data.x[0, :4])
+    by = jnp.asarray(data.y[0, :4])
+
+    def loss_fn(p):
+        return criterion(t.model.apply(p, bx), by)
+
+    g = jax.grad(loss_fn)(server.params)
+    expected = jax.tree.map(lambda p, gg: p - 0.1 * gg, server.params, g)
+
+    server2, clients2, metrics = t.run_round(server, clients)
+    assert float(metrics.online_mask[0]) == 1.0
+    assert float(metrics.online_mask[1]) == 0.0
+    for a, b in zip(jax.tree.leaves(server2.params),
+                    jax.tree.leaves(expected)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-6, rtol=1e-6)
+    # not the wrap-around result: 100 wrapped steps would move far more
+    moved = sum(float(jnp.abs(a - b).sum()) for a, b in zip(
+        jax.tree.leaves(server2.params), jax.tree.leaves(p0)))
+    assert moved > 0
+
+
+def test_per_client_step_budgets_respected():
+    """Both clients online: local_index advances by each client's OWN
+    budget (1 vs 100) and both end the round at +1.0 epoch."""
+    t, _ = _trainer(sizes=(4, 400), rate=1.0)
+    server, clients = t.init_state(jax.random.key(1))
+    server, clients, _ = t.run_round(server, clients)
+    li = np.asarray(clients.local_index)
+    ep = np.asarray(clients.epoch)
+    assert li[0] == 1 and li[1] == 100, li
+    np.testing.assert_allclose(ep, [1.0, 1.0], atol=1e-4)
+    # second round: budgets accumulate, never wrap
+    server, clients, _ = t.run_round(server, clients)
+    li = np.asarray(clients.local_index)
+    assert li[0] == 2 and li[1] == 200, li
+
+
+def test_scaffold_control_uses_effective_steps():
+    """SCAFFOLD's control update divides delta by the client's OWN step
+    count (scaffold.py:26-27 with K = the client's steps). Round 0
+    forces the 4-sample client online alone: its new control must be
+    (server0 - x)/(1*lr) = the plain batch gradient, NOT grad/100."""
+    t, data = _trainer(sizes=(4, 400), rate=0.5, algorithm="scaffold")
+    server, clients = t.init_state(jax.random.key(0))
+
+    criterion = make_criterion(False)
+    bx, by = jnp.asarray(data.x[0, :4]), jnp.asarray(data.y[0, :4])
+    g = jax.grad(lambda p: criterion(t.model.apply(p, bx), by))(
+        server.params)
+
+    server2, clients2, metrics = t.run_round(server, clients)
+    assert float(metrics.online_mask[0]) == 1.0
+    for got, expect in zip(jax.tree.leaves(clients2.aux["control"]),
+                           jax.tree.leaves(g)):
+        np.testing.assert_allclose(np.asarray(got)[0], np.asarray(expect),
+                                   atol=1e-5, rtol=1e-5)
+
+
+def test_perfedme_sync_pull_fires_at_own_last_step():
+    """PerFedMe pulls w toward theta at the client's last ACTIVE step
+    (perfedme.py:115-124 fires where the reference's loop exits). With
+    only the short client online, the server model must MOVE — a masked
+    pull would make its delta exactly zero."""
+    t, _ = _trainer(sizes=(4, 400), rate=0.5, algorithm="perfedme",
+                    personal=True)
+    server, clients = t.init_state(jax.random.key(0))
+    p0 = jax.tree.map(np.asarray, server.params)
+    server2, clients2, metrics = t.run_round(server, clients)
+    assert float(metrics.online_mask[0]) == 1.0
+    moved = sum(float(jnp.abs(a - b).sum()) for a, b in zip(
+        jax.tree.leaves(server2.params), jax.tree.leaves(p0)))
+    assert moved > 1e-8, "short client's sync pull was masked out"
+
+
+def test_drfa_snapshot_clamped_into_active_range():
+    """DRFA's shared random snapshot step is clamped to each client's
+    own budget, so an early-exited client ships a REAL kth model, never
+    its zero-initialized placeholder."""
+    t, data = _trainer(sizes=(4, 400), rate=0.5, algorithm="fedavg",
+                       drfa=True)
+    server, clients = t.init_state(jax.random.key(0))
+
+    criterion = make_criterion(False)
+    bx, by = jnp.asarray(data.x[0, :4]), jnp.asarray(data.y[0, :4])
+    g = jax.grad(lambda p: criterion(t.model.apply(p, bx), by))(
+        server.params)
+    # short client budget = 1 -> snapshot after its single step:
+    # kth = server0 - lr*g; kth_avg = kth / k_online (k_online = 1)
+    expected = jax.tree.map(lambda p, gg: p - 0.1 * gg, server.params, g)
+
+    server2, clients2, metrics = t.run_round(server, clients)
+    assert float(metrics.online_mask[0]) == 1.0
+    for got, expect in zip(jax.tree.leaves(server2.aux["kth_avg"]),
+                           jax.tree.leaves(expected)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
+                                   atol=1e-5, rtol=1e-5)
+
+
+def test_fedgate_tracking_uses_effective_steps():
+    """FedGATE's tracking update divides by the client's OWN steps
+    (fedgate.py:102-104): short client's delta_track must reflect 1
+    step, not the scan length 100."""
+    t, data = _trainer(sizes=(4, 400), rate=0.5, algorithm="fedgate")
+    server, clients = t.init_state(jax.random.key(0))
+
+    criterion = make_criterion(False)
+    bx, by = jnp.asarray(data.x[0, :4]), jnp.asarray(data.y[0, :4])
+    g = jax.grad(lambda p: criterion(t.model.apply(p, bx), by))(
+        server.params)
+
+    server2, clients2, metrics = t.run_round(server, clients)
+    assert float(metrics.online_mask[0]) == 1.0
+    # delta_round = lr*g; payload_sum = w*delta with w=1 (only client);
+    # track' = 0 + (delta - payload_sum)/(lr*K_eff) = 0 for this
+    # single-client case regardless of K_eff — so instead check via
+    # weights 0.5: use both clients online
+    t2, data2 = _trainer(sizes=(4, 400), rate=1.0, algorithm="fedgate")
+    s, c = t2.init_state(jax.random.key(0))
+    s2, c2, _ = t2.run_round(s, c)
+    track0 = np.concatenate([np.asarray(leaf)[0].ravel()
+                             for leaf in jax.tree.leaves(
+                                 c2.aux["delta"])])
+    # with effective steps=1 the short client's tracking term
+    # (delta - sum)/(lr*1) is ~100x the buggy /(lr*100) version; just
+    # pin that it is the same order of magnitude as the raw gradient
+    gnorm = float(sum(jnp.abs(x).sum() for x in jax.tree.leaves(g)))
+    assert np.abs(track0).sum() > gnorm * 0.05
+
+
+def test_equal_sizes_unaffected_by_masking():
+    """With no skew every step is active — the masked program must match
+    the plain local-step program run for the same step count."""
+    t_epoch, _ = _trainer(sizes=(40, 40), rate=1.0)
+    assert t_epoch.local_steps == 10
+    # same engine in local_step mode, same K
+    cfg = ExperimentConfig(
+        data=DataConfig(dataset="synthetic", synthetic_dim=DIM,
+                        batch_size=B),
+        federated=FederatedConfig(federated=True, num_clients=2,
+                                  online_client_rate=1.0,
+                                  algorithm="fedavg",
+                                  sync_type="local_step"),
+        model=ModelConfig(arch="logistic_regression"),
+        optim=OptimConfig(lr=0.1, weight_decay=0.0, in_momentum=False),
+        train=TrainConfig(local_step=10),
+        mesh=MeshConfig(num_devices=1),
+    ).finalize()
+    model = define_model(cfg, batch_size=B)
+    t_steps = FederatedTrainer(cfg, model, make_algorithm(cfg),
+                               _skewed_data(sizes=(40, 40)))
+    s1, c1 = t_epoch.init_state(jax.random.key(2))
+    s2, c2 = t_steps.init_state(jax.random.key(2))
+    s1, c1, m1 = t_epoch.run_round(s1, c1)
+    s2, c2, m2 = t_steps.run_round(s2, c2)
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-6)
+    np.testing.assert_allclose(np.asarray(m1.train_loss),
+                               np.asarray(m2.train_loss), atol=1e-6)
