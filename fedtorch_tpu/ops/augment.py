@@ -32,3 +32,97 @@ def augment_image_batch(rng: jax.Array, x: jnp.ndarray,
         return jax.lax.dynamic_slice(img, (top, left, 0), (h, w, c))
 
     return jax.vmap(crop)(xp, tops, lefts)
+
+
+# -- color toolkit (preprocess_toolkit.py:124-214) -----------------------
+# The reference's AlexNet-style PCA lighting and brightness/contrast/
+# saturation jitter (used by its inception_color_preproccess preset,
+# preprocess_toolkit.py:66-80; its main CIFAR/MNIST path uses only
+# flip+crop above). All transforms are jittable, batched [B, H, W, 3],
+# with per-sample randomness from the given key.
+
+# ImageNet PCA statistics (preprocess_toolkit.py:10-17)
+IMAGENET_PCA_EIGVAL = (0.2175, 0.0188, 0.0045)
+IMAGENET_PCA_EIGVEC = ((-0.5675, 0.7192, 0.4009),
+                       (-0.5808, -0.0045, -0.8140),
+                       (-0.5836, -0.6948, 0.4203))
+
+
+def pca_lighting(rng: jax.Array, x: jnp.ndarray,
+                 alphastd: float = 0.1) -> jnp.ndarray:
+    """AlexNet PCA lighting noise (Lighting, preprocess_toolkit.py:124-142):
+    adds ``eigvec @ (alpha * eigval)`` per sample to every pixel, with
+    ``alpha ~ N(0, alphastd)`` drawn per sample per channel."""
+    if alphastd == 0:
+        return x
+    b = x.shape[0]
+    eigval = jnp.asarray(IMAGENET_PCA_EIGVAL)
+    eigvec = jnp.asarray(IMAGENET_PCA_EIGVEC)
+    alpha = alphastd * jax.random.normal(rng, (b, 3))
+    rgb = (eigvec[None] * (alpha * eigval)[:, None, :]).sum(-1)  # [B, 3]
+    return x + rgb[:, None, None, :]
+
+
+def _grayscale(x: jnp.ndarray) -> jnp.ndarray:
+    """ITU-R 601-2 luma replicated over RGB (Grayscale,
+    preprocess_toolkit.py:145-152)."""
+    gs = (0.299 * x[..., 0] + 0.587 * x[..., 1] + 0.114 * x[..., 2])
+    return jnp.repeat(gs[..., None], 3, axis=-1)
+
+
+def _lerp(x, target, alpha):
+    return x + alpha[:, None, None, None] * (target - x)
+
+
+def saturation_jitter(rng, x, var: float):
+    """lerp toward grayscale by alpha ~ U(0, var)
+    (Saturation, preprocess_toolkit.py:155-163)."""
+    alpha = jax.random.uniform(rng, (x.shape[0],), maxval=var)
+    return _lerp(x, _grayscale(x), alpha)
+
+
+def brightness_jitter(rng, x, var: float):
+    """lerp toward black by alpha ~ U(0, var)
+    (Brightness, preprocess_toolkit.py:166-174)."""
+    alpha = jax.random.uniform(rng, (x.shape[0],), maxval=var)
+    return _lerp(x, jnp.zeros_like(x), alpha)
+
+
+def contrast_jitter(rng, x, var: float):
+    """lerp toward the per-sample mean gray level by alpha ~ U(0, var)
+    (Contrast, preprocess_toolkit.py:177-185)."""
+    alpha = jax.random.uniform(rng, (x.shape[0],), maxval=var)
+    gs_mean = _grayscale(x).mean(axis=(1, 2, 3), keepdims=True)
+    return _lerp(x, jnp.broadcast_to(gs_mean, x.shape), alpha)
+
+
+def color_jitter(rng: jax.Array, x: jnp.ndarray, brightness: float = 0.4,
+                 contrast: float = 0.4, saturation: float = 0.4):
+    """Brightness/contrast/saturation jitter applied in a RANDOM ORDER
+    per batch (ColorJitter(RandomOrder), preprocess_toolkit.py:188-214),
+    via a branch over the 6 permutations so it stays jittable."""
+    import itertools
+    r_order, r_b, r_c, r_s = jax.random.split(rng, 4)
+    ops = [lambda v: brightness_jitter(r_b, v, brightness),
+           lambda v: contrast_jitter(r_c, v, contrast),
+           lambda v: saturation_jitter(r_s, v, saturation)]
+    perms = list(itertools.permutations(range(3)))
+
+    def make_branch(perm):
+        def branch(v):
+            for i in perm:
+                v = ops[i](v)
+            return v
+        return branch
+
+    which = jax.random.randint(r_order, (), 0, len(perms))
+    return jax.lax.switch(which, [make_branch(p) for p in perms], x)
+
+
+def inception_color_batch(rng: jax.Array, x: jnp.ndarray) -> jnp.ndarray:
+    """The reference's color-augmentation preset: ColorJitter(0.4,0.4,0.4)
+    then PCA Lighting(0.1) (inception_color_preproccess,
+    preprocess_toolkit.py:66-80), minus the resize/crop stages our data
+    layout already fixes."""
+    r_j, r_l = jax.random.split(rng)
+    return pca_lighting(r_l, color_jitter(r_j, x), alphastd=0.1)

@@ -1,3 +1,7 @@
+from fedtorch_tpu.tools.plots import (  # noqa: F401
+    build_legend, configure_figure, determine_color_and_lines,
+    plot_one_case, plot_runs, reject_outliers,
+)
 from fedtorch_tpu.tools.records import (  # noqa: F401
     load_record_file, parse_records, smoothing,
 )

@@ -1,0 +1,114 @@
+"""Figure helpers for parsed record tables.
+
+Parity with ``fedtorch/tools/plot_utils.py``: deterministic
+color/line/marker assignment per curve (plot_utils.py:80-103),
+axis/legend styling (configure_figure, :107-122), single-curve plotting
+(plot_one_case, :125-133), legend construction from run hyperparameters
+(build_legend, :136-143), outlier rejection (:42-43), and a
+``plot_runs`` convenience that turns :func:`parse_records` output
+directly into a comparison figure.
+
+matplotlib is imported lazily so headless/metrics-only installs never
+pay for it.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from fedtorch_tpu.tools.records import smoothing
+
+_LINE_STYLES = ["-", "--", "-.", ":"]
+# colorblind-safe palette (plot_utils.py:82-85)
+_COLOR_STYLES = ["#377eb8", "#ff7f00", "#4daf4a", "#f781bf", "#a65628",
+                 "#984ea3", "#999999", "#e41a1c", "#dede00"]
+
+
+def reject_outliers(data, threshold: float = 3.0) -> np.ndarray:
+    """Drop points further than ``threshold`` stds from the mean
+    (plot_utils.py:42-43)."""
+    data = np.asarray(data)
+    return data[np.abs(data - data.mean()) < threshold * data.std()]
+
+
+def determine_color_and_lines(ind: int):
+    """Deterministic (line style, color, marker) for curve ``ind``
+    (plot_utils.py:80-103 without the grid-shape special cases)."""
+    from matplotlib.lines import Line2D
+    markers = Line2D.filled_markers
+    return (_LINE_STYLES[(ind // len(_COLOR_STYLES)) % len(_LINE_STYLES)],
+            _COLOR_STYLES[ind % len(_COLOR_STYLES)],
+            markers[ind % len(markers)])
+
+
+def configure_figure(ax, xlabel: str, ylabel: str,
+                     title: Optional[str] = None, has_legend: bool = True,
+                     legend_loc: str = "lower right",
+                     legend_ncol: int = 2):
+    """Axis labels / legend / tick styling (plot_utils.py:107-122)."""
+    if has_legend:
+        ax.legend(loc=legend_loc, ncol=legend_ncol, shadow=True,
+                  fancybox=True, fontsize=12)
+    ax.set_xlabel(xlabel, fontsize=14)
+    ax.set_ylabel(ylabel, fontsize=14)
+    if title is not None:
+        ax.set_title(title, fontsize=14)
+    ax.xaxis.set_tick_params(labelsize=12)
+    ax.yaxis.set_tick_params(labelsize=12)
+    return ax
+
+
+def plot_one_case(ax, x, y, label: str, ind: int = 0,
+                  line_width: float = 2.0, markevery: int = 50):
+    """One styled curve (plot_one_case, plot_utils.py:125-133)."""
+    line, color, marker = determine_color_and_lines(ind)
+    ax.plot(np.asarray(x), np.asarray(y), label=label,
+            linewidth=line_width, linestyle=line, color=color,
+            marker=marker, markevery=markevery, markersize=8)
+    return ax
+
+
+def build_legend(run_name: str, keys: Sequence[str]) -> str:
+    """Legend text from the hyperparam-encoded run-folder name
+    (build_legend, plot_utils.py:136-143): run folders are
+    ``key-value`` parts joined by underscores (checkpoint.py naming)."""
+    parts = dict(p.split("-", 1) for p in run_name.split("_")
+                 if "-" in p)
+    return ", ".join(f"{k}={parts[k]}" for k in keys if k in parts)
+
+
+def plot_runs(runs: List[dict], metric: str = "top1", mode: str = "test",
+              x_key: str = "round", legend_keys: Sequence[str] = ("alg",),
+              smooth_window: int = 1, out_path: Optional[str] = None,
+              title: Optional[str] = None):
+    """Comparison figure across parsed runs (parse_records output):
+    one styled curve per run of ``metric`` vs ``x_key`` from the val
+    table (or the train table when ``mode='train'``). Saves to
+    ``out_path`` when given; returns the matplotlib figure."""
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots(figsize=(8, 5))
+    for ind, run in enumerate(runs):
+        if mode == "train":
+            rows: List[Dict] = run["records"]["train"]
+        else:
+            rows = [r for r in run["records"]["val"]
+                    if r.get("mode") == mode]
+        if not rows:
+            continue
+        x = [r[x_key] for r in rows]
+        y = [r[metric] for r in rows]
+        if smooth_window > 1:
+            y = smoothing(y, smooth_window)
+            x = x[:len(y)]
+        label = build_legend(run["name"], legend_keys) or run["name"]
+        plot_one_case(ax, x, y, label, ind=ind,
+                      markevery=max(len(x) // 10, 1))
+    configure_figure(ax, xlabel=x_key, ylabel=metric, title=title)
+    fig.tight_layout()
+    if out_path is not None:
+        fig.savefig(out_path, dpi=120)
+    return fig

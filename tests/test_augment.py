@@ -47,6 +47,65 @@ def test_config_default_resolution():
     assert cfg3.data.augment is False
 
 
+class TestColorToolkit:
+    """PCA lighting + color jitter (preprocess_toolkit.py:124-214)."""
+
+    def _img(self, seed=0, b=4):
+        rng = np.random.RandomState(seed)
+        return jnp.asarray(rng.rand(b, 8, 8, 3).astype(np.float32))
+
+    def test_lighting_zero_std_identity(self):
+        from fedtorch_tpu.ops.augment import pca_lighting
+        x = self._img()
+        np.testing.assert_array_equal(
+            np.asarray(pca_lighting(jax.random.key(0), x, alphastd=0.0)),
+            np.asarray(x))
+
+    def test_lighting_adds_constant_rgb_per_sample(self):
+        from fedtorch_tpu.ops.augment import pca_lighting
+        x = self._img()
+        out = np.asarray(pca_lighting(jax.random.key(1), x))
+        shift = out - np.asarray(x)
+        # the PCA shift is a per-sample per-channel constant over pixels
+        for b in range(x.shape[0]):
+            for c in range(3):
+                assert np.allclose(shift[b, :, :, c],
+                                   shift[b, 0, 0, c], atol=1e-6)
+        # and differs across samples
+        assert not np.allclose(shift[0, 0, 0], shift[1, 0, 0])
+
+    def test_grayscale_luma_weights(self):
+        from fedtorch_tpu.ops.augment import _grayscale
+        x = self._img()
+        gs = np.asarray(_grayscale(x))
+        expected = (0.299 * np.asarray(x)[..., 0]
+                    + 0.587 * np.asarray(x)[..., 1]
+                    + 0.114 * np.asarray(x)[..., 2])
+        np.testing.assert_allclose(gs[..., 0], expected, atol=1e-6)
+        np.testing.assert_array_equal(gs[..., 0], gs[..., 1])
+        np.testing.assert_array_equal(gs[..., 1], gs[..., 2])
+
+    def test_jitter_bounded_and_jittable(self):
+        from fedtorch_tpu.ops.augment import color_jitter
+        x = self._img()
+        out = jax.jit(color_jitter)(jax.random.key(2), x)
+        out = np.asarray(out)
+        # brightness/contrast/saturation lerps keep values in [0, max]
+        assert np.isfinite(out).all()
+        assert out.min() >= -1e-6
+        assert out.max() <= float(np.asarray(x).max()) + 1e-6
+        # different keys produce different jitter
+        out2 = np.asarray(jax.jit(color_jitter)(jax.random.key(3), x))
+        assert not np.allclose(out, out2)
+
+    def test_inception_color_preset(self):
+        from fedtorch_tpu.ops.augment import inception_color_batch
+        x = self._img()
+        out = jax.jit(inception_color_batch)(jax.random.key(4), x)
+        assert out.shape == x.shape
+        assert np.isfinite(np.asarray(out)).all()
+
+
 def test_engine_gates_on_image_data():
     """Augment flag set but data is flat -> engine stays off; image data
     -> engine trains with augmentation and stays finite."""

@@ -86,6 +86,45 @@ class TestLoggingRoundTrip:
         assert out[0] == pytest.approx(2.0)
 
 
+class TestPlots:
+    def test_styles_deterministic_and_distinct(self):
+        from fedtorch_tpu.tools import determine_color_and_lines
+        a = determine_color_and_lines(0)
+        b = determine_color_and_lines(1)
+        assert a == determine_color_and_lines(0)
+        assert a != b
+
+    def test_reject_outliers(self):
+        from fedtorch_tpu.tools import reject_outliers
+        data = np.asarray([1.0, 1.1, 0.9, 1.0, 50.0])
+        kept = reject_outliers(data, threshold=1.5)
+        assert 50.0 not in kept and len(kept) == 4
+
+    def test_build_legend_from_run_name(self):
+        from fedtorch_tpu.tools import build_legend
+        name = ("2026-01-01_00-00-00_l2-0.0_lr-0.1_arch-mlp_"
+                "alg-fedavg_clients-10")
+        assert build_legend(name, ("alg", "clients")) == \
+            "alg=fedavg, clients=10"
+
+    def test_plot_runs_writes_figure(self, tmp_path):
+        run_dir = tmp_path / "lr-0.1_arch-mlp_alg-fedavg"
+        run_dir.mkdir()
+        logger = RunLogger(str(run_dir), debug=False)
+        for r in range(5):
+            logger.log_train(r, float(r), 1.0 / (r + 1), 0.5 + 0.05 * r,
+                             0.1)
+            logger.log_val(r, "test", 1.0 / (r + 1), 0.5 + 0.05 * r,
+                           0.9)
+        from fedtorch_tpu.tools import parse_records, plot_runs
+        runs = parse_records(str(tmp_path))
+        out = tmp_path / "curves.png"
+        fig = plot_runs(runs, metric="top1", mode="test",
+                        legend_keys=("alg",), out_path=str(out))
+        assert out.exists() and out.stat().st_size > 0
+        assert fig.axes[0].get_ylabel() == "top1"
+
+
 class TestCheckpoint:
     def test_full_state_roundtrip(self, tmp_path):
         """SCAFFOLD control variates must survive a resume — the gap the
