@@ -174,11 +174,16 @@ def main():
         f"on {n_chips} chip(s)")
 
     baseline = measure_torch_baseline()
+    note = ("zero-egress container: CIFAR-shaped synthetic shards "
+            "(real CIFAR download gated)")
+    if fallback_cpu:
+        note += "; TPU RELAY WEDGED - CPU fallback, not a TPU number"
     print(json.dumps({
         "metric": "fedavg_resnet20_cifar10_100clients_local_steps_per_sec_per_chip",
         "value": round(steps_per_sec, 2),
         "unit": "local-steps/sec/chip",
         "vs_baseline": round(steps_per_sec / baseline, 2),
+        "notes": note,
     }), flush=True)
 
 

@@ -52,8 +52,11 @@ class FedAlgorithm:
         self.model = None
         self.criterion = None
         # set by the engine before tracing (static round length / static
-        # online-client count)
+        # online-client count / mesh size)
         self.local_steps_per_round = max(cfg.train.local_step, 1)
+        # devices the client axis is sharded over; wire-format kernels
+        # without a partitioning rule (pallas) must stay off when > 1
+        self.mesh_devices = 1
         self.k_online = max(
             int(cfg.federated.online_client_rate
                 * cfg.federated.num_clients), 1)
@@ -187,6 +190,16 @@ class FedAlgorithm:
         collective, plus updated aux. delta = server - client.
         ``full_loss`` is provided when ``needs_full_loss`` is set."""
         return tree_scale(delta, weight), client_aux
+
+    def payload_batch_transform(self, payloads):
+        """Uplink wire-format transform on the STACKED [k, ...] online
+        payloads, applied by the engine AFTER the vmapped client loop
+        and BEFORE the aggregation sum. Semantics are per-client
+        (leading-axis slices get independent statistics); living outside
+        the vmap lets grid-based kernels (the pallas client-grid
+        quantizer) serve the uplink, which ``pallas_call`` under vmap
+        cannot. Identity by default."""
+        return payloads
 
     def aggregate_transform(self, payload_sum):
         """Downlink wire-format transform of the aggregated payload.

@@ -135,6 +135,11 @@ class DRFA(FedAlgorithm):
                                      1.0 / self.k_online)}
         return payload, dict(client_aux, inner=inner_aux)
 
+    def payload_batch_transform(self, payloads):
+        return dict(payloads,
+                    inner=self.inner.payload_batch_transform(
+                        payloads["inner"]))
+
     def aggregate_transform(self, payload_sum):
         return dict(payload_sum,
                     inner=self.inner.aggregate_transform(

@@ -22,7 +22,6 @@ import jax.numpy as jnp
 from fedtorch_tpu.algorithms.base import FedAlgorithm
 from fedtorch_tpu.core import optim
 from fedtorch_tpu.core.state import tree_scale
-from fedtorch_tpu.ops.quantize import quantize_dequantize
 
 
 class FedAvg(FedAlgorithm):
@@ -30,19 +29,33 @@ class FedAvg(FedAlgorithm):
 
     def client_payload(self, *, delta, client_aux, params, server_params,
                        server_aux, lr, local_steps, weight, full_loss=None):
-        payload = tree_scale(delta, weight)
+        # uplink quantization happens in payload_batch_transform (on the
+        # stacked client axis, outside the vmap) — not here
+        return tree_scale(delta, weight), client_aux
+
+    def payload_batch_transform(self, payloads):
         if self.cfg.federated.quantized:
+            # per-client uplink quantization (fedavg.py:34-38) via the
+            # client-grid pallas kernel (one VMEM pass per client's
+            # payload). XLA vmap fallback off-TPU AND when the client
+            # axis is sharded over >1 device: the pallas custom call has
+            # no GSPMD partitioning rule, while XLA's quantizer
+            # partitions cleanly with the axis.
+            from fedtorch_tpu.ops.pallas import \
+                fused_quantize_dequantize_batch
             bits = self.cfg.federated.quantized_bits
-            payload = jax.tree.map(
-                lambda x: quantize_dequantize(x, bits), payload)
-        return payload, client_aux
+            payloads = jax.tree.map(
+                lambda x: fused_quantize_dequantize_batch(
+                    x, bits, sharded=self.mesh_devices > 1),
+                payloads)
+        return payloads
 
     def aggregate_transform(self, payload_sum):
         if self.cfg.federated.quantized:
             # downlink re-quantization of the summed delta (fedavg.py:54-64)
             # — the fused pallas kernel when on TPU (one VMEM pass), XLA
-            # otherwise; the vmapped uplink path stays XLA (pallas_call
-            # has no batching rule)
+            # otherwise; the uplink is served by the client-grid kernel
+            # in payload_batch_transform
             from fedtorch_tpu.ops.pallas import fused_quantize_dequantize
             bits = self.cfg.federated.quantized_bits
             payload_sum = jax.tree.map(

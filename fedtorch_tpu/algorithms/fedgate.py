@@ -23,7 +23,6 @@ import jax
 
 from fedtorch_tpu.algorithms.base import FedAlgorithm
 from fedtorch_tpu.core.state import tree_scale, tree_zeros_like
-from fedtorch_tpu.ops.quantize import quantize_dequantize
 from fedtorch_tpu.ops.topk import topk_roundtrip
 
 
@@ -46,9 +45,8 @@ class FedGate(FedAlgorithm):
         fed = self.cfg.federated
         weighted = tree_scale(delta, weight)
         if fed.quantized:
-            payload = jax.tree.map(
-                lambda x: quantize_dequantize(x, fed.quantized_bits),
-                weighted)
+            # quantized uplink applied in payload_batch_transform
+            payload = weighted
         elif fed.compressed:
             # g = w*delta + w*memory, top-k sparsified (fedgate.py:59-66)
             payload = jax.tree.map(
@@ -58,6 +56,20 @@ class FedGate(FedAlgorithm):
         else:
             payload = weighted
         return payload, client_aux
+
+    def payload_batch_transform(self, payloads):
+        if self.cfg.federated.quantized:
+            # FedCOMGATE quantized uplink (fedgate.py:33-44), per-client
+            # stats on the stacked axis via the client-grid kernel;
+            # XLA fallback when the client axis spans multiple devices
+            # (no pallas partitioning rule)
+            from fedtorch_tpu.ops.pallas import \
+                fused_quantize_dequantize_batch
+            payloads = jax.tree.map(
+                lambda x: fused_quantize_dequantize_batch(
+                    x, self.cfg.federated.quantized_bits,
+                    sharded=self.mesh_devices > 1), payloads)
+        return payloads
 
     def aggregate_transform(self, payload_sum):
         # FedCOMGATE downlink: the re-quantized aggregate feeds BOTH the

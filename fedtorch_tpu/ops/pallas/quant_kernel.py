@@ -27,13 +27,11 @@ _LANE = 128
 _MAX_VMEM_ELEMS = 2 * 1024 * 1024  # 8 MB of f32
 
 
-def _qdq_kernel(n_ref, x_ref, out_ref, *, num_bits: int):
-    import jax.numpy as jnp  # kernel-local alias
-
+def _qdq_math(x, n, num_bits: int):
+    """The fused statistics + affine round-trip on one [rows, cols]
+    VMEM-resident block with ``n`` valid leading elements."""
     qmin = -(2.0 ** (num_bits - 1))
     qmax = 2.0 ** (num_bits - 1) - 1.0
-    x = x_ref[:]
-    n = n_ref[0]
     rows, cols = x.shape
     flat_idx = (jax.lax.broadcasted_iota(jnp.int32, (rows, cols), 0) * cols
                 + jax.lax.broadcasted_iota(jnp.int32, (rows, cols), 1))
@@ -48,7 +46,18 @@ def _qdq_kernel(n_ref, x_ref, out_ref, *, num_bits: int):
     scale = jnp.where(scale == 0.0, 0.001, scale)
     zp = jnp.trunc(jnp.clip(qmin - (mn - mean) / scale, qmin, qmax))
     q = jnp.clip(jnp.round(zp + (x - mean) / scale), qmin, qmax)
-    out_ref[:] = scale * (q - zp) + mean
+    return scale * (q - zp) + mean
+
+
+def _qdq_kernel(n_ref, x_ref, out_ref, *, num_bits: int):
+    out_ref[:] = _qdq_math(x_ref[:], n_ref[0], num_bits)
+
+
+def _qdq_batch_kernel(n_ref, x_ref, out_ref, *, num_bits: int):
+    """Grid-over-clients cell: one client's [1, rows, cols] block per
+    program instance — statistics are PER CLIENT, exactly the vmapped
+    per-client semantics of the uplink (fedavg.py:34-38)."""
+    out_ref[0] = _qdq_math(x_ref[0], n_ref[0], num_bits)
 
 
 @functools.partial(jax.jit, static_argnames=("num_bits",))
@@ -68,6 +77,66 @@ def _pallas_qdq_padded(x2d: jnp.ndarray, n: jnp.ndarray,
     )(n, x2d)
 
 
+@functools.partial(jax.jit, static_argnames=("num_bits", "interpret"))
+def _pallas_qdq_batch_padded(x3d: jnp.ndarray, n: jnp.ndarray,
+                             num_bits: int,
+                             interpret: bool = False) -> jnp.ndarray:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    C, rows, lane = x3d.shape
+    return pl.pallas_call(
+        functools.partial(_qdq_batch_kernel, num_bits=num_bits),
+        grid=(C,),
+        out_shape=jax.ShapeDtypeStruct(x3d.shape, jnp.float32),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, rows, lane), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, rows, lane), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(n, x3d)
+
+
+def fused_quantize_dequantize_batch(x: jnp.ndarray, num_bits: int = 8,
+                                    force_pallas: bool = False,
+                                    interpret: bool = False,
+                                    sharded: bool = False) -> jnp.ndarray:
+    """Per-slice quantize->dequantize over the LEADING axis: slice i gets
+    its own statistics, identical to ``vmap(quantize_dequantize)``.
+
+    This is the uplink kernel: the engine stacks the online clients'
+    payloads as [k, ...] after the vmapped local loop, and the grid runs
+    one program instance per client — covering the path the single-block
+    kernel cannot (``pallas_call`` has no batching rule, so calling it
+    under vmap falls back to XLA).
+
+    ``sharded=True`` declares the leading axis sharded over multiple
+    devices: the pallas custom call has no GSPMD partitioning rule, so
+    the XLA path (which partitions cleanly) is used instead."""
+    C = x.shape[0]
+    n = 1
+    for d in x.shape[1:]:
+        n *= int(d)
+    use_pallas = (force_pallas
+                  or (_on_tpu() and n <= _MAX_VMEM_ELEMS
+                      and not sharded)) \
+        and not _is_batch_traced(x) and n > 0
+    if not use_pallas:
+        return jax.vmap(lambda v: _xla_qdq(v, num_bits))(x)
+    rows = -(-n // _LANE)
+    rows = -(-rows // 8) * 8
+    padded = jnp.zeros((C, rows * _LANE), jnp.float32)
+    padded = padded.at[:, :n].set(
+        x.reshape(C, -1).astype(jnp.float32))
+    out = _pallas_qdq_batch_padded(padded.reshape(C, rows, _LANE),
+                                   jnp.asarray([n], jnp.int32), num_bits,
+                                   interpret)
+    return out.reshape(C, -1)[:, :n].reshape(x.shape).astype(x.dtype)
+
+
 def _on_tpu() -> bool:
     try:
         return jax.default_backend() in ("tpu", "axon")
@@ -76,8 +145,13 @@ def _on_tpu() -> bool:
 
 
 def _is_batch_traced(x) -> bool:
-    from jax.interpreters import batching
-    return isinstance(x, batching.BatchTracer)
+    try:
+        from jax._src.interpreters.batching import BatchTracer
+        return isinstance(x, BatchTracer)
+    except ImportError:  # future jax relayout: fall back on the name
+        import jax.core
+        return isinstance(x, jax.core.Tracer) \
+            and type(x).__name__ == "BatchTracer"
 
 
 def fused_quantize_dequantize(x: jnp.ndarray, num_bits: int = 8,

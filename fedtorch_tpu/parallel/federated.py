@@ -135,6 +135,7 @@ class FederatedTrainer:
         algorithm.k_online = self.k_online
         self.mesh = mesh if mesh is not None else make_mesh(
             cfg.mesh, self.num_clients)
+        algorithm.mesh_devices = int(self.mesh.devices.size)
         # the client axis is padded up to a multiple of the mesh size with
         # inert (never-sampled, size-0) clients so EVERY device holds an
         # equal shard — no chip idles when num_clients has no large
@@ -379,6 +380,10 @@ class FederatedTrainer:
             client_round)(on_clients, on_x, on_y, on_vx, on_vy, on_sizes,
                           on_vsizes, weights, rngs)
 
+        # uplink wire format on the stacked [k] payload axis (per-client
+        # quantization via the pallas client-grid kernel — outside the
+        # vmap, where pallas_call can actually run)
+        payloads = alg.payload_batch_transform(payloads)
         # the aggregation collective: sum over the (sharded) client axis,
         # then the downlink wire-format transform applied ONCE so the
         # server step and client_post see the same (e.g. re-quantized) sum

@@ -103,7 +103,9 @@ def plot_runs(runs: List[dict], metric: str = "top1", mode: str = "test",
         y = [r[metric] for r in rows]
         if smooth_window > 1:
             y = smoothing(y, smooth_window)
-            x = x[:len(y)]
+            # trailing averages align to their window END (the reference
+            # smoothing_func anchors at the same x, plot_utils.py:10-30)
+            x = x[len(x) - len(y):]
         label = build_legend(run["name"], legend_keys) or run["name"]
         plot_one_case(ax, x, y, label, ind=ind,
                       markevery=max(len(x) // 10, 1))
