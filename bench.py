@@ -42,20 +42,47 @@ def log(*a):
 def probe_device(timeout_s: int = 120) -> bool:
     """Check that the default JAX platform initializes, in a SUBPROCESS
     with a timeout: the TPU relay in this container can wedge
-    indefinitely, and a hung bench is worse than a CPU fallback."""
+    indefinitely, and a hung bench is worse than a CPU fallback.
+
+    Retries a few times (BENCH_PROBE_TRIES, default 3) with a pause —
+    the relay's wedge clears on a server-side timeout, so patience at
+    bench time can be the difference between a real TPU number and a
+    CPU fallback."""
     import subprocess
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.devices())"],
-            timeout=timeout_s, capture_output=True)
-        ok = r.returncode == 0
-        if not ok:
-            log(f"device probe failed: {r.stderr.decode()[-200:]}")
-        return ok
-    except subprocess.TimeoutExpired:
-        log(f"device probe timed out after {timeout_s}s")
+    tries = int(os.environ.get("BENCH_PROBE_TRIES", "3"))
+    timeout_s = int(os.environ.get("BENCH_PROBE_TIMEOUT", timeout_s))
+    for attempt in range(1, tries + 1):
+        p = subprocess.Popen(
+            [sys.executable, "-c", "import jax; print(jax.devices())"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+        try:
+            p.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            # NEVER SIGKILL a process that may hold the relay session —
+            # that is the documented wedge trigger. SIGTERM + grace lets
+            # it close the session; SIGKILL only as a last resort.
+            p.terminate()
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+            log(f"device probe attempt {attempt}/{tries} timed out "
+                f"after {timeout_s}s")
+            if attempt < tries:
+                # the relay's server-side grant timeout is minutes, not
+                # seconds — a short pause would probe a wedge we may
+                # have just refreshed
+                time.sleep(120)
+            continue
+        if p.returncode == 0:
+            return True
+        # deterministic failure (import error, config) — retrying
+        # cannot change the outcome
+        log("device probe failed: "
+            f"{p.stderr.read().decode()[-200:]}")
         return False
+    return False
 
 
 def measure_torch_baseline() -> float:
