@@ -23,6 +23,10 @@ import time
 # plain SGD step loop -> 5.76 steps/s (see docstring; remeasured live when
 # possible).
 TORCH_CPU_FALLBACK_STEPS_PER_SEC = 5.76
+# Best torch-CPU rate ever observed live on this host (round-1 bench run,
+# unloaded). The live measurement is floored here so concurrent CPU load
+# at bench time cannot deflate the baseline and overstate vs_baseline.
+TORCH_CPU_BEST_OBSERVED = 18.20
 
 import os
 
@@ -49,43 +53,51 @@ def probe_device(timeout_s: int = 120) -> bool:
     bench time can be the difference between a real TPU number and a
     CPU fallback."""
     import subprocess
+    import tempfile
     tries = int(os.environ.get("BENCH_PROBE_TRIES", "3"))
     timeout_s = int(os.environ.get("BENCH_PROBE_TIMEOUT", timeout_s))
     for attempt in range(1, tries + 1):
-        p = subprocess.Popen(
-            [sys.executable, "-c", "import jax; print(jax.devices())"],
-            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
-        try:
-            p.wait(timeout=timeout_s)
-        except subprocess.TimeoutExpired:
-            # NEVER SIGKILL a process that may hold the relay session —
-            # that is the documented wedge trigger. SIGTERM + grace lets
-            # it close the session; SIGKILL only as a last resort.
-            p.terminate()
+        # stderr goes to a temp FILE, not a PIPE: a child emitting more
+        # than the pipe buffer (long plugin-init tracebacks) would block
+        # on write and masquerade as a relay wedge.
+        with tempfile.TemporaryFile() as errf:
+            p = subprocess.Popen(
+                [sys.executable, "-c",
+                 "import jax; print(jax.devices())"],
+                stdout=subprocess.DEVNULL, stderr=errf)
             try:
-                p.wait(timeout=30)
+                p.wait(timeout=timeout_s)
             except subprocess.TimeoutExpired:
-                p.kill()
-                p.wait()
-            log(f"device probe attempt {attempt}/{tries} timed out "
-                f"after {timeout_s}s")
-            if attempt < tries:
-                # the relay's server-side grant timeout is minutes, not
-                # seconds — a short pause would probe a wedge we may
-                # have just refreshed
-                time.sleep(120)
-            continue
-        if p.returncode == 0:
-            return True
-        # deterministic failure (import error, config) — retrying
-        # cannot change the outcome
-        log("device probe failed: "
-            f"{p.stderr.read().decode()[-200:]}")
-        return False
+                # NEVER SIGKILL a process that may hold the relay
+                # session — that is the documented wedge trigger.
+                # SIGTERM + grace lets it close the session; SIGKILL
+                # only as a last resort.
+                p.terminate()
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()
+                log(f"device probe attempt {attempt}/{tries} timed out "
+                    f"after {timeout_s}s")
+                if attempt < tries:
+                    # the relay's server-side grant timeout is minutes,
+                    # not seconds — a short pause would probe a wedge we
+                    # may have just refreshed
+                    time.sleep(120)
+                continue
+            if p.returncode == 0:
+                return True
+            # deterministic failure (import error, config) — retrying
+            # cannot change the outcome
+            errf.seek(0)
+            log("device probe failed: "
+                f"{errf.read().decode(errors='replace')[-200:]}")
+            return False
     return False
 
 
-def measure_torch_baseline() -> float:
+def measure_torch_baseline() -> "tuple[float, bool]":
     try:
         import types
         sys.path.insert(0, "/root/reference")
@@ -109,10 +121,10 @@ def measure_torch_baseline() -> float:
             opt.step()
         rate = n / (time.time() - t0)
         log(f"torch-cpu baseline measured live: {rate:.2f} steps/s")
-        return rate
+        return rate, True
     except Exception as e:  # reference not mounted / torch missing
         log(f"torch baseline unavailable ({e}); using fallback constant")
-        return TORCH_CPU_FALLBACK_STEPS_PER_SEC
+        return TORCH_CPU_FALLBACK_STEPS_PER_SEC, False
 
 
 def main():
@@ -125,7 +137,6 @@ def main():
             "workload so the run finishes promptly; steps/sec/chip stays "
             "an honest per-step rate.")
         os.environ["JAX_PLATFORMS"] = "cpu"
-        global BATCH_SIZE, LOCAL_STEPS
         ONLINE_RATE = 0.01   # 1 online client/round
         TIMED_ROUNDS = 1
         LOCAL_STEPS = 5
@@ -200,18 +211,49 @@ def main():
     log(f"{steps} local steps in {dt:.2f}s over {TIMED_ROUNDS} rounds "
         f"on {n_chips} chip(s)")
 
-    baseline = measure_torch_baseline()
+    # MFU estimate (analytic): resnet20-cifar forward = 40.8e6 MACs/image
+    # (stem 0.44M + 3 stages x ~13-14M + fc; matches the 41M figure in the
+    # ResNet paper). Training step ~= 3x forward, 2 FLOPs/MAC.
+    mfu_pct = None
+    if not fallback_cpu:
+        peak_tflops = float(os.environ.get(
+            "BENCH_PEAK_TFLOPS",
+            "197" if dtype == "bfloat16" else "98"))  # TPU v5e per chip
+        train_flops_per_image = 3 * 2 * 40.8e6
+        achieved = steps_per_sec * n_chips * BATCH_SIZE \
+            * train_flops_per_image
+        mfu_pct = round(100 * achieved / (peak_tflops * 1e12 * n_chips), 2)
+        log(f"MFU estimate: {mfu_pct}% of {peak_tflops} TFLOPs/chip "
+            f"({achieved/1e12:.2f} TFLOPs/s achieved; small 32x32 convs "
+            f"underfill the MXU — expected for this workload class)")
+
+    baseline, baseline_is_live = measure_torch_baseline()
     note = ("zero-egress container: CIFAR-shaped synthetic shards "
             "(real CIFAR download gated)")
     if fallback_cpu:
         note += "; TPU RELAY WEDGED - CPU fallback, not a TPU number"
-    print(json.dumps({
+    elif baseline_is_live and baseline < TORCH_CPU_BEST_OBSERVED:
+        # TPU mode only: our side doesn't feel host CPU load but the live
+        # torch measurement does, so a loaded host would overstate
+        # vs_baseline. Floor at the best rate observed on THIS host
+        # (round-1 unloaded run) and disclose both numbers. In CPU
+        # fallback both sides share the load - no floor there.
+        note += (f"; torch baseline floored at best-observed "
+                 f"{TORCH_CPU_BEST_OBSERVED} steps/s (live measurement "
+                 f"{baseline:.2f} under concurrent host load)")
+        log(f"flooring torch baseline {baseline:.2f} -> "
+            f"{TORCH_CPU_BEST_OBSERVED} (concurrent-load guard)")
+        baseline = TORCH_CPU_BEST_OBSERVED
+    record = {
         "metric": "fedavg_resnet20_cifar10_100clients_local_steps_per_sec_per_chip",
         "value": round(steps_per_sec, 2),
         "unit": "local-steps/sec/chip",
         "vs_baseline": round(steps_per_sec / baseline, 2),
         "notes": note,
-    }), flush=True)
+    }
+    if mfu_pct is not None:
+        record["mfu_pct"] = mfu_pct
+    print(json.dumps(record), flush=True)
 
 
 if __name__ == "__main__":

@@ -35,31 +35,31 @@ class FedAvg(FedAlgorithm):
 
     def payload_batch_transform(self, payloads):
         if self.cfg.federated.quantized:
-            # per-client uplink quantization (fedavg.py:34-38) via the
-            # client-grid pallas kernel (one VMEM pass per client's
-            # payload). XLA vmap fallback off-TPU AND when the client
-            # axis is sharded over >1 device: the pallas custom call has
-            # no GSPMD partitioning rule, while XLA's quantizer
-            # partitions cleanly with the axis.
+            # per-client uplink quantization (fedavg.py:34-38), bucketed
+            # by leaf size so equal-sized tensors share one client-grid
+            # pallas launch (per-slice stats = exact per-tensor,
+            # per-client semantics). XLA vmap fallback off-TPU AND when
+            # the client axis is sharded over >1 device: the pallas
+            # custom call has no GSPMD partitioning rule, while XLA's
+            # quantizer partitions cleanly with the axis.
             from fedtorch_tpu.ops.pallas import \
-                fused_quantize_dequantize_batch
+                fused_quantize_dequantize_tree
             bits = self.cfg.federated.quantized_bits
-            payloads = jax.tree.map(
-                lambda x: fused_quantize_dequantize_batch(
-                    x, bits, sharded=self.mesh_devices > 1),
-                payloads)
+            payloads = fused_quantize_dequantize_tree(
+                payloads, bits, leading_batch=True,
+                sharded=self.mesh_devices > 1)
         return payloads
 
     def aggregate_transform(self, payload_sum):
         if self.cfg.federated.quantized:
             # downlink re-quantization of the summed delta (fedavg.py:54-64)
-            # — the fused pallas kernel when on TPU (one VMEM pass), XLA
-            # otherwise; the uplink is served by the client-grid kernel
-            # in payload_batch_transform
-            from fedtorch_tpu.ops.pallas import fused_quantize_dequantize
+            # — same bucketed kernel path (the sum is replicated, never
+            # sharded, so bucketing is always safe here)
+            from fedtorch_tpu.ops.pallas import \
+                fused_quantize_dequantize_tree
             bits = self.cfg.federated.quantized_bits
-            payload_sum = jax.tree.map(
-                lambda x: fused_quantize_dequantize(x, bits), payload_sum)
+            payload_sum = fused_quantize_dequantize_tree(
+                payload_sum, bits)
         return payload_sum
 
 

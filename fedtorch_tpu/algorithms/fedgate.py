@@ -60,15 +60,15 @@ class FedGate(FedAlgorithm):
     def payload_batch_transform(self, payloads):
         if self.cfg.federated.quantized:
             # FedCOMGATE quantized uplink (fedgate.py:33-44), per-client
-            # stats on the stacked axis via the client-grid kernel;
-            # XLA fallback when the client axis spans multiple devices
-            # (no pallas partitioning rule)
+            # stats on the stacked axis, bucketed by leaf size (one
+            # client-grid launch per distinct size); XLA fallback when
+            # the client axis spans multiple devices (no pallas
+            # partitioning rule)
             from fedtorch_tpu.ops.pallas import \
-                fused_quantize_dequantize_batch
-            payloads = jax.tree.map(
-                lambda x: fused_quantize_dequantize_batch(
-                    x, self.cfg.federated.quantized_bits,
-                    sharded=self.mesh_devices > 1), payloads)
+                fused_quantize_dequantize_tree
+            payloads = fused_quantize_dequantize_tree(
+                payloads, self.cfg.federated.quantized_bits,
+                leading_batch=True, sharded=self.mesh_devices > 1)
         return payloads
 
     def aggregate_transform(self, payload_sum):
@@ -76,10 +76,10 @@ class FedGate(FedAlgorithm):
         # server step and the clients' tracking/memory updates
         # (fedgate.py:74-79 broadcasts the re-quantized tensor)
         if self.cfg.federated.quantized:
-            from fedtorch_tpu.ops.pallas import fused_quantize_dequantize
-            payload_sum = jax.tree.map(
-                lambda x: fused_quantize_dequantize(
-                    x, self.cfg.federated.quantized_bits), payload_sum)
+            from fedtorch_tpu.ops.pallas import \
+                fused_quantize_dequantize_tree
+            payload_sum = fused_quantize_dequantize_tree(
+                payload_sum, self.cfg.federated.quantized_bits)
         return payload_sum
 
     def client_post(self, *, delta, client_aux, payload_sum, lr,

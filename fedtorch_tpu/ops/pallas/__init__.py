@@ -1,3 +1,4 @@
 from fedtorch_tpu.ops.pallas.quant_kernel import (  # noqa: F401
     fused_quantize_dequantize, fused_quantize_dequantize_batch,
+    fused_quantize_dequantize_tree,
 )

@@ -138,3 +138,103 @@ class TestBatchKernel:
         server, clients, m = t.run_round(server, clients)
         assert calls, "engine never invoked payload_batch_transform"
         assert np.isfinite(float(m.train_loss.sum()))
+
+
+class TestTiledKernel:
+    """Two-pass grid-tiled kernel for payloads past the single-block
+    VMEM ceiling (real-TPU scoped-vmem limit is ~786k f32 elems)."""
+
+    def _run_tiled(self, x, bits=8):
+        from fedtorch_tpu.ops.pallas.quant_kernel import (
+            _LANE, _TILE_ROWS, _pallas_qdq_tiled)
+        n = x.size
+        rows = -(-n // _LANE)
+        rows = -(-rows // _TILE_ROWS) * _TILE_ROWS
+        padded = jnp.zeros((rows * _LANE,), jnp.float32).at[:n].set(
+            x.reshape(-1))
+        out = _pallas_qdq_tiled(padded.reshape(rows, _LANE),
+                                jnp.asarray([n], jnp.int32), bits,
+                                interpret=True)
+        return np.asarray(out).reshape(-1)[:n].reshape(x.shape)
+
+    @pytest.mark.parametrize("n,bits", [(200_000, 8), (200_000, 16),
+                                        (65_536, 8)])
+    def test_matches_xla_within_one_bin(self, n, bits):
+        # Block-sequential stat accumulation reorders the mean sum, which
+        # can flip bin-boundary elements by exactly one bin; everything
+        # else must agree.
+        rng = np.random.RandomState(n % 1000)
+        x = jnp.asarray(rng.randn(n).astype(np.float32) * 2)
+        got = self._run_tiled(x, bits)
+        want = np.asarray(quantize_dequantize(x, bits))
+        bin_w = (float(x.max()) - float(x.min())) / (2 ** bits - 1)
+        assert np.abs(got - want).max() < 1.05 * bin_w
+        # boundary flips must be rare: stats agree to ~ulp, so <0.1% of
+        # elements may move a bin
+        frac = np.mean(np.abs(got - want) > 0.51 * bin_w)
+        assert frac < 1e-3
+
+    def test_multi_block_padding_excluded_from_stats(self):
+        # 70_000 elems -> 2 blocks of (512,128) with a padded tail; a
+        # positive-only payload detects zero-padding leaking into min
+        x = jnp.asarray(np.linspace(5.0, 9.0, 70_000, dtype=np.float32))
+        got = self._run_tiled(x)
+        want = np.asarray(quantize_dequantize(x, 8))
+        # a zero leaking into min would shift every output by ~5.0 (the
+        # affine grid would span [0, 9]); one-bin flips at linspace's
+        # exact bin boundaries are the only acceptable difference
+        bin_w = 4.0 / 255
+        assert np.abs(got - want).max() < 1.05 * bin_w
+        assert np.mean(np.abs(got - want) > 0.51 * bin_w) < 1e-3
+
+
+class TestTreeTransform:
+    """Size-bucketed whole-tree quantization (one grid launch per
+    distinct leaf size, per-tensor stats preserved)."""
+
+    def _tree(self, rng, lead=None):
+        shp = lambda *s: (lead, *s) if lead else s
+        return {
+            "conv1": jnp.asarray(rng.randn(*shp(3, 3, 4)).astype(np.float32)),
+            "conv2": jnp.asarray(
+                rng.randn(*shp(6, 2, 3)).astype(np.float32) * 5),
+            "bias": jnp.asarray(rng.randn(*shp(16,)).astype(np.float32)),
+            "bias2": jnp.asarray(rng.randn(*shp(16,)).astype(np.float32) * 9),
+        }
+
+    def test_matches_per_leaf_xla(self):
+        from fedtorch_tpu.ops.pallas import fused_quantize_dequantize_tree
+        tree = self._tree(np.random.RandomState(0))
+        got = fused_quantize_dequantize_tree(tree, 8)
+        want = jax.tree.map(lambda x: quantize_dequantize(x, 8), tree)
+        for g, w in zip(jax.tree.leaves(got), jax.tree.leaves(want)):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       atol=1e-6)
+            assert g.shape == w.shape and g.dtype == w.dtype
+
+    def test_leading_batch_per_client_stats(self):
+        from fedtorch_tpu.ops.pallas import fused_quantize_dequantize_tree
+        tree = self._tree(np.random.RandomState(1), lead=3)
+        got = fused_quantize_dequantize_tree(tree, 8, leading_batch=True)
+        want = jax.tree.map(
+            lambda x: jax.vmap(lambda v: quantize_dequantize(v, 8))(x), tree)
+        for g, w in zip(jax.tree.leaves(got), jax.tree.leaves(want)):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       atol=1e-6)
+
+    def test_under_vmap_falls_back(self):
+        """Called with batch tracers (inside the client vmap) the tree
+        transform must still be correct via the XLA fallback."""
+        from fedtorch_tpu.ops.pallas import fused_quantize_dequantize_tree
+        tree = self._tree(np.random.RandomState(2), lead=4)
+        got = jax.vmap(
+            lambda t: fused_quantize_dequantize_tree(t, 8))(tree)
+        want = jax.tree.map(
+            lambda x: jax.vmap(lambda v: quantize_dequantize(v, 8))(x), tree)
+        for g, w in zip(jax.tree.leaves(got), jax.tree.leaves(want)):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       atol=1e-6)
+
+    def test_empty_tree(self):
+        from fedtorch_tpu.ops.pallas import fused_quantize_dequantize_tree
+        assert fused_quantize_dequantize_tree({}, 8) == {}
