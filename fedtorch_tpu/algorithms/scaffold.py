@@ -22,6 +22,15 @@ The control-variate pair rides the same aggregation collective as the
 model delta (the reference stacks them into one tensor per param,
 scaffold.py:38-56 — here they are just two pytree branches of the
 payload).
+
+Momentum caveat (measured, not hypothetical): the control update
+``(x_s - x_i)/(K*lr)`` equals the mean local gradient ONLY under plain
+SGD. With ``in_momentum`` the realized per-step displacement is up to
+``1/(1-m)`` times larger, the controls over-estimate, and training
+diverges exponentially — in the reference exactly as here (verified
+side-by-side on the reference's centered scaffold with
+``--in_momentum True``: both trajectories blow up within ~15 rounds,
+2026-07-29). Run SCAFFOLD with plain local SGD, as in the paper.
 """
 from __future__ import annotations
 

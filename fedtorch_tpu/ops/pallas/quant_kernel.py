@@ -155,9 +155,10 @@ def _pallas_qdq_tiled(x2d: jnp.ndarray, n: jnp.ndarray,
     )(stats, n, x2d)
 
 
-@functools.partial(jax.jit, static_argnames=("num_bits",))
+@functools.partial(jax.jit, static_argnames=("num_bits", "interpret"))
 def _pallas_qdq_padded(x2d: jnp.ndarray, n: jnp.ndarray,
-                       num_bits: int) -> jnp.ndarray:
+                       num_bits: int,
+                       interpret: bool = False) -> jnp.ndarray:
     return pl.pallas_call(
         functools.partial(_qdq_kernel, num_bits=num_bits),
         out_shape=jax.ShapeDtypeStruct(x2d.shape, jnp.float32),
@@ -166,6 +167,7 @@ def _pallas_qdq_padded(x2d: jnp.ndarray, n: jnp.ndarray,
             pl.BlockSpec(memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=interpret,
     )(n, x2d)
 
 
@@ -228,7 +230,9 @@ def fused_quantize_dequantize_batch(x: jnp.ndarray, num_bits: int = 8,
 
 def fused_quantize_dequantize_tree(tree, num_bits: int = 8,
                                    leading_batch: bool = False,
-                                   sharded: bool = False):
+                                   sharded: bool = False,
+                                   force_pallas: bool = False,
+                                   interpret: bool = False):
     """Per-tensor quantize->dequantize over a whole pytree, bucketed by
     flattened size: leaves of equal size are stacked and served by ONE
     client-grid kernel launch (per-slice stats keep exact per-tensor
@@ -250,7 +254,8 @@ def fused_quantize_dequantize_tree(tree, num_bits: int = 8,
     leaves, treedef = jax.tree.flatten(tree)
     if not leaves:
         return tree
-    if sharded or not _on_tpu() or any(_is_batch_traced(x) for x in leaves):
+    if (sharded or not (_on_tpu() or force_pallas)
+            or any(_is_batch_traced(x) for x in leaves)):
         if leading_batch:
             out = [fused_quantize_dequantize_batch(x, num_bits,
                                                    sharded=sharded)
@@ -279,18 +284,23 @@ def fused_quantize_dequantize_tree(tree, num_bits: int = 8,
                 leaf = leaves[i]
                 if leading_batch:
                     qs = jnp.stack([
-                        fused_quantize_dequantize(leaf[c], num_bits)
+                        fused_quantize_dequantize(leaf[c], num_bits,
+                                                  force_pallas, interpret)
                         for c in range(k)])
                     out[i] = qs.reshape(leaf.shape).astype(leaf.dtype)
                 else:
-                    out[i] = fused_quantize_dequantize(leaf, num_bits)
+                    out[i] = fused_quantize_dequantize(leaf, num_bits,
+                                                       force_pallas,
+                                                       interpret)
             continue
         if leading_batch:
             stacked = jnp.stack(
                 [leaves[i].reshape(k, n) for i in idxs]).reshape(-1, n)
         else:
             stacked = jnp.stack([leaves[i].reshape(n) for i in idxs])
-        q = fused_quantize_dequantize_batch(stacked, num_bits)
+        q = fused_quantize_dequantize_batch(stacked, num_bits,
+                                            force_pallas=force_pallas,
+                                            interpret=interpret)
         if leading_batch:
             q = q.reshape(len(idxs), k, n)
         for j, i in enumerate(idxs):
@@ -316,7 +326,8 @@ def _is_batch_traced(x) -> bool:
 
 
 def fused_quantize_dequantize(x: jnp.ndarray, num_bits: int = 8,
-                              force_pallas: bool = False) -> jnp.ndarray:
+                              force_pallas: bool = False,
+                              interpret: bool = False) -> jnp.ndarray:
     """Drop-in replacement for ops.quantize.quantize_dequantize."""
     n = x.size
     use_pallas = (force_pallas
@@ -331,12 +342,14 @@ def fused_quantize_dequantize(x: jnp.ndarray, num_bits: int = 8,
         padded = jnp.zeros((rows * _LANE,), jnp.float32)
         padded = padded.at[:n].set(x.reshape(-1).astype(jnp.float32))
         out = _pallas_qdq_padded(padded.reshape(rows, _LANE),
-                                 jnp.asarray([n], jnp.int32), num_bits)
+                                 jnp.asarray([n], jnp.int32), num_bits,
+                                 interpret)
     else:
         rows = -(-n // _LANE)
         rows = -(-rows // _TILE_ROWS) * _TILE_ROWS
         padded = jnp.zeros((rows * _LANE,), jnp.float32)
         padded = padded.at[:n].set(x.reshape(-1).astype(jnp.float32))
         out = _pallas_qdq_tiled(padded.reshape(rows, _LANE),
-                                jnp.asarray([n], jnp.int32), num_bits)
+                                jnp.asarray([n], jnp.int32), num_bits,
+                                interpret)
     return out.reshape(-1)[:n].reshape(x.shape).astype(x.dtype)

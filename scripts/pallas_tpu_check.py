@@ -207,19 +207,29 @@ def main():
             f"speedup={t_x/t_p:.2f}x")
 
     results["all_correct"] = bool(max_err_bound_ok)
+    # Derive the summary from this run's measurements — never assert
+    # validation or wins the adjacent keys don't show.
+    tiled = [v["speedup"] for k, v in results["bench"].items()
+             if k.startswith("single_")]
+    small = [v.get("speedup", v.get("speedup_vs_perleaf_xla"))
+             for k, v in results["bench"].items()
+             if not k.startswith("single_")]
+    corr = ("Correctness of the real-TPU lowering validated on every case "
+            "(single-block, client-grid batch, two-pass tiled kernels)."
+            if max_err_bound_ok else
+            "CORRECTNESS FAILURES on the real-TPU lowering - see the "
+            "'correctness' list; do not trust the kernels until fixed.")
     results["finding"] = (
-        "Correctness of the real-TPU lowering is fully validated (single-"
-        "block, client-grid batch, and two-pass tiled kernels). Across "
-        "three timing runs on the relay-attached v5e: the TILED kernel "
-        "wins consistently (~2x) on multi-MB single tensors (2M f32: "
-        "1.96-2.04x vs XLA) — the bandwidth-bound regime it targets; the "
-        "small resnet20-sized sweeps are launch-bound and vary +/-30% "
-        "run to run with XLA slightly ahead as often as behind. The "
-        "kernels stay the default on unsharded TPU paths: at-worst "
-        "noise-equivalent on small payloads, consistently faster on "
-        "large ones, single-pass stats guaranteed at every size, and "
-        "whole payload trees bucketed into one launch per distinct leaf "
-        "size; the XLA path remains the fallback everywhere else.")
+        f"{corr} This run's timings: multi-MB single tensors "
+        f"{min(tiled):.2f}-{max(tiled):.2f}x vs XLA (tiled kernel; "
+        f"~2x wins have been consistent across sessions at 2M elems), "
+        f"small launch-bound sweeps {min(small):.2f}-{max(small):.2f}x "
+        f"(within the +/-30% run-to-run noise of the relay-attached "
+        f"v5e). Kernels stay the default on unsharded TPU paths: "
+        f"at-worst noise-equivalent on small payloads, faster on large "
+        f"ones, single-pass stats at every size, payload trees bucketed "
+        f"into one launch per distinct leaf size; XLA remains the "
+        f"fallback elsewhere.")
     with open("PALLAS_TPU.json", "w") as f:
         json.dump(results, f, indent=1)
     print(json.dumps({"pallas_tpu_ok": results["all_correct"],

@@ -253,3 +253,61 @@ class TestScaffoldBeatsFedAvgOnHeterogeneous:
         r_sca = evaluate(t_sca.model, s_sca.params, data.test_x,
                          data.test_y, batch_size=128)
         assert float(r_sca.top1) > float(r_avg.top1) - 0.15
+
+
+def test_scaffold_momentum_caveat_pinned():
+    """SCAFFOLD control variates assume plain local SGD: with in_momentum
+    the controls over-estimate the mean gradient and training diverges —
+    in the reference exactly as here (verified side-by-side on the
+    reference's centered scaffold). Pin both behaviors: plain SGD stays
+    bounded in a drift regime where momentum blows up."""
+    import numpy as np
+    from fedtorch_tpu.algorithms import make_algorithm
+    from fedtorch_tpu.config import (
+        DataConfig, ExperimentConfig, FederatedConfig, MeshConfig,
+        ModelConfig, OptimConfig, TrainConfig,
+    )
+    from fedtorch_tpu.data.batching import stack_partitions
+    from fedtorch_tpu.data.partition import dirichlet_partition
+    from fedtorch_tpu.models import define_model
+    from fedtorch_tpu.parallel import FederatedTrainer
+
+    rng = np.random.RandomState(7)
+    C, B, K, N_PER, D = 12, 8, 10, 32, 16
+    means = rng.randn(6, D).astype(np.float32) * 1.5
+    labels = rng.randint(0, 6, C * N_PER)
+    feats = means[labels] + rng.randn(C * N_PER, D).astype(np.float32)
+    parts = [p for p in dirichlet_partition(labels, C, concentration=0.3,
+                                            seed=1) if len(p)]
+    data = stack_partitions(feats, labels, parts)
+
+    def final_loss(momentum: bool) -> float:
+        cfg = ExperimentConfig(
+            data=DataConfig(dataset="synthetic", synthetic_dim=D,
+                            batch_size=B),
+            federated=FederatedConfig(federated=True,
+                                      num_clients=data.num_clients,
+                                      online_client_rate=1.0,
+                                      algorithm="scaffold",
+                                      sync_type="local_step"),
+            model=ModelConfig(arch="mlp", mlp_num_layers=1,
+                              mlp_hidden_size=24),
+            optim=OptimConfig(lr=0.1, in_momentum=momentum),
+            train=TrainConfig(local_step=K),
+            mesh=MeshConfig(num_devices=1),
+        ).finalize()
+        model = define_model(cfg, batch_size=B)
+        trainer = FederatedTrainer(cfg, model, make_algorithm(cfg), data)
+        server, clients = trainer.init_state(jax.random.key(0))
+        loss = float("nan")
+        for _ in range(12):
+            server, clients, m = trainer.run_round(server, clients)
+            loss = float(m.train_loss.sum()
+                         / max(float(m.online_mask.sum()), 1))
+        return loss
+
+    plain = final_loss(False)
+    with_mom = final_loss(True)
+    assert np.isfinite(plain) and plain < 5.0, plain
+    assert not np.isfinite(with_mom) or with_mom > 4 * plain, \
+        (plain, with_mom)

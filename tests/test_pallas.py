@@ -238,3 +238,36 @@ class TestTreeTransform:
     def test_empty_tree(self):
         from fedtorch_tpu.ops.pallas import fused_quantize_dequantize_tree
         assert fused_quantize_dequantize_tree({}, 8) == {}
+
+    def test_bucket_path_reachable_in_interpret_mode(self, monkeypatch):
+        """Exercise the TPU bucket/stack/unstack code (not the CPU
+        per-leaf fallback) via force_pallas+interpret, including the
+        oversize branch (per-slice size past the VMEM ceiling) with the
+        ceiling shrunk so small arrays take it."""
+        import fedtorch_tpu.ops.pallas.quant_kernel as qk
+        monkeypatch.setattr(qk, "_MAX_VMEM_ELEMS", 256)
+        rng = np.random.RandomState(5)
+        tree = {
+            # bucketable pair (same size) under the shrunk ceiling
+            "a": jnp.asarray(rng.randn(200).astype(np.float32)),
+            "b": jnp.asarray(rng.randn(200).astype(np.float32) * 4),
+            # oversize leaf -> per-leaf fused path
+            "big": jnp.asarray(rng.randn(700).astype(np.float32)),
+        }
+        got = qk.fused_quantize_dequantize_tree(
+            tree, 8, force_pallas=True, interpret=True)
+        want = jax.tree.map(lambda x: quantize_dequantize(x, 8), tree)
+        for k in tree:
+            np.testing.assert_allclose(np.asarray(got[k]),
+                                       np.asarray(want[k]), atol=5e-6)
+
+        # leading_batch layout: oversize slices go through the per-slice
+        # fused loop; per-client stats must hold
+        up = {"w": jnp.asarray(rng.randn(3, 700).astype(np.float32)
+                               * np.arange(1, 4)[:, None])}
+        got_u = qk.fused_quantize_dequantize_tree(
+            up, 8, leading_batch=True, force_pallas=True, interpret=True)
+        want_u = jax.tree.map(
+            lambda x: jax.vmap(lambda v: quantize_dequantize(v, 8))(x), up)
+        np.testing.assert_allclose(np.asarray(got_u["w"]),
+                                   np.asarray(want_u["w"]), atol=5e-6)
