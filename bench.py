@@ -154,6 +154,9 @@ def main():
         DataConfig, ExperimentConfig, FederatedConfig, ModelConfig,
         OptimConfig, TrainConfig,
     )
+    from fedtorch_tpu.utils import enable_compile_cache
+    cache_dir = enable_compile_cache()
+    log(f"persistent compile cache: {cache_dir}")
     from fedtorch_tpu.data.batching import stack_partitions
     from fedtorch_tpu.models import define_model
     from fedtorch_tpu.parallel import FederatedTrainer

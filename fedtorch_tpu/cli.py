@@ -271,6 +271,9 @@ def run_experiment(cfg: ExperimentConfig,
     import jax
     import jax.numpy as jnp
 
+    from fedtorch_tpu.utils import enable_compile_cache
+    enable_compile_cache()
+
     from fedtorch_tpu.algorithms import make_algorithm
     from fedtorch_tpu.core.schedule import lr_at
     from fedtorch_tpu.data import build_federated_data

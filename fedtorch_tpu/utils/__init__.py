@@ -10,3 +10,4 @@ from fedtorch_tpu.utils.meters import (  # noqa: F401
     AverageMeter, PhaseTimer, define_local_training_tracker,
     define_val_tracker,
 )
+from fedtorch_tpu.utils.compile_cache import enable_compile_cache  # noqa: F401,E501

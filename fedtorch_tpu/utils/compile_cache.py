@@ -1,0 +1,43 @@
+"""Persistent XLA compilation cache.
+
+The federated round program compiles in ~40-50s on the TPU (v5e via the
+relay; scripts/pallas_tpu_check.py, BASELINE_REPRO.md timings) and every
+entry point — CLI runs, bench.py, the driver's compile checks, the
+comparison scripts — pays it again for identical programs. JAX's
+persistent cache keys on (HLO, compile options, platform version), so a
+shared on-disk cache turns repeat compiles into a load.
+
+The reference has no analog (eager torch does not compile); this is
+TPU-runtime scope.
+"""
+from __future__ import annotations
+
+import os
+
+_DEFAULT_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), ".jax_cache")
+
+
+def enable_compile_cache(cache_dir: str | None = None) -> str | None:
+    """Point JAX's persistent compilation cache at ``cache_dir``
+    (default: ``<repo>/.jax_cache``; override with FEDTORCH_JAX_CACHE,
+    disable with FEDTORCH_JAX_CACHE=0). Safe to call more than once and
+    before or after backend init; returns the directory in use or None
+    when disabled/unsupported."""
+    env = os.environ.get("FEDTORCH_JAX_CACHE")
+    if env == "0":
+        return None
+    path = cache_dir or env or _DEFAULT_DIR
+    try:
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # cache everything that took noticeable compile time; tiny
+        # programs aren't worth the disk round-trip
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        return path
+    except Exception:  # old jax without the flags: cache is best-effort
+        return None

@@ -46,7 +46,6 @@ def _model_cases():
 
     def run(arch, feats, labels, *, dataset, dtype="float32", C=4, B=4,
             model_kw=None, seq=None):
-        rng = np.random.RandomState(0)
         parts = [np.arange(i * len(feats) // C, (i + 1) * len(feats) // C)
                  for i in range(C)]
         data = stack_partitions(feats, labels, parts)
@@ -69,9 +68,10 @@ def _model_cases():
         server, clients = trainer.init_state(jax.random.key(0))
         server, clients, m = trainer.run_round(server, clients)
         jax.block_until_ready(server.params)
-        return float(m.train_loss.sum())
+        # same normalization as the zoo loop: per-online-client mean
+        return float(m.train_loss.sum()
+                     / max(float(m.online_mask.sum()), 1.0))
 
-    import numpy as np
     rng = np.random.RandomState(3)
 
     def resnet_bf16():
@@ -143,12 +143,18 @@ def main():
             ok = False
             log(f"{name}: FAIL {str(e)[:200]}")
 
+    if not on_tpu:
+        # the whole point is the real TPU toolchain: a CPU run proves
+        # nothing and must not produce a passing artifact
+        ok = False
+        log("NOT ON TPU — recording failure; rerun when the relay is up")
     results["all_ok"] = bool(ok)
     results["note"] = ("single-chip execution of every zoo case; the "
                        "sharded multi-device program is covered by "
                        "dryrun_multichip on the virtual CPU mesh"
                        if on_tpu else
-                       "CPU RUN — does not validate the TPU toolchain")
+                       "CPU RUN — does not validate the TPU toolchain; "
+                       "all_ok forced false")
     with open("TPU_ZOO.json", "w") as f:
         json.dump(results, f, indent=1)
     print(json.dumps({"tpu_zoo_ok": ok,
