@@ -1,0 +1,53 @@
+"""Long-context inference with ring attention over a device mesh.
+
+The transformer LM's attention can run as EXACT ring attention
+(parallel/sequence.py): the sequence axis is sharded over the mesh, each
+device holds one block of queries, and key/value blocks rotate around the
+ring via ``ppermute`` — attention memory per device drops from O(T^2) to
+O(T * T/n) with no approximation. On a TPU pod the rotation rides ICI.
+
+This example runs a 2048-token context over an 8-way sequence-parallel
+mesh and checks the sharded result against single-device attention.
+
+Run (no TPU needed):
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    JAX_PLATFORMS=cpu python examples/03_long_context_ring_attention.py
+"""
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fedtorch_tpu.utils import honor_platform_env
+honor_platform_env()  # respect JAX_PLATFORMS=cpu for device-free runs
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from fedtorch_tpu.models.transformer import TransformerLM, \
+    long_context_apply
+
+SEQ_LEN, VOCAB = 2048, 128
+
+devices = jax.devices()
+mesh = Mesh(np.asarray(devices), ("sp",))
+print(f"sequence axis sharded over {len(devices)} devices")
+
+model = TransformerLM(vocab_size=VOCAB, num_layers=2, num_heads=4,
+                      d_model=64, max_len=SEQ_LEN)
+tokens = jax.random.randint(jax.random.key(1), (1, SEQ_LEN), 0, VOCAB)
+params = model.init(jax.random.key(0), tokens)["params"]
+
+# sharded: every attention block runs exact ring attention over the mesh
+logits_ring = long_context_apply(model, params, tokens, mesh)
+
+# single-device baseline: ordinary causal attention
+logits_full = model.apply({"params": params}, tokens)
+
+err = float(jnp.max(jnp.abs(logits_ring - logits_full)))
+print(f"max |ring - full| over [1, {SEQ_LEN}, {VOCAB}] logits: {err:.2e}")
+assert err < 1e-3, "ring attention diverged from the exact baseline"
+print("ok: exact long-context attention, sequence-parallel over the mesh")

@@ -11,3 +11,4 @@ from fedtorch_tpu.utils.meters import (  # noqa: F401
     define_val_tracker,
 )
 from fedtorch_tpu.utils.compile_cache import enable_compile_cache  # noqa: F401,E501
+from fedtorch_tpu.utils.platform import honor_platform_env  # noqa: F401

@@ -1,0 +1,26 @@
+"""Backend-selection helper shared by entry points, scripts, examples.
+
+Some environments install a site hook that pins ``jax_platforms`` to a
+TPU proxy at interpreter start, which silently overrides the standard
+``JAX_PLATFORMS=cpu`` escape hatch — a CPU-only run then blocks on TPU
+backend bring-up. ``honor_platform_env`` re-asserts the user's explicit
+environment choice through ``jax.config`` (a no-op everywhere else).
+"""
+from __future__ import annotations
+
+import os
+
+
+def honor_platform_env() -> None:
+    """If JAX_PLATFORMS is explicitly set, make jax.config agree with it
+    even when a site hook pre-set a different platform. Call before the
+    first backend touch (``jax.devices``/first dispatch)."""
+    want = os.environ.get("JAX_PLATFORMS", "").strip().lower()
+    if not want:
+        return
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", want)
+    except Exception:
+        pass  # backend already initialized or option unknown: keep going

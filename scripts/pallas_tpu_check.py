@@ -232,8 +232,9 @@ def main():
             "'correctness' list; do not trust the kernels until fixed.")
     results["finding"] = (
         f"{corr} This run's timings: multi-MB payloads "
-        f"{min(big):.2f}-{max(big):.2f}x vs XLA (tiled kernel; "
-        f"~2x wins have been consistent across sessions at 2M elems), "
+        f"{min(big):.2f}-{max(big):.2f}x vs XLA across the tiled and "
+        f"client-grid batch kernels (the tiled kernel's ~2x win at 2M "
+        f"elems has been consistent across sessions), "
         f"small launch-bound sweeps {min(small):.2f}-{max(small):.2f}x "
         f"(within the +/-30% run-to-run noise of the relay-attached "
         f"v5e). Kernels stay the default on unsharded TPU paths: "

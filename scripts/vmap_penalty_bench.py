@@ -1,0 +1,129 @@
+"""Quantify the cost of per-client weights in the federated hot loop.
+
+Federated local training gives every online client its OWN parameters, so
+the round program vmaps the train step over a [k] client axis of weights:
+XLA lowers the convolutions with ``batch_group_count=k`` (grouped conv)
+instead of one large dense conv. This script measures that penalty on the
+current backend by timing a single fwd+bwd train step three ways on
+identical total work (k*B images):
+
+  shared   — one conv batch of k*B images, one weight set (the ceiling:
+             what a non-federated data-parallel step would cost)
+  vmapped  — vmap over k clients with k weight sets (the federated round's
+             actual shape)
+  scanned  — lax.scan over the k clients (serialized small batches)
+
+The gap between `shared` and `vmapped` is the price of federated
+semantics, not implementation slack; `scanned` shows the alternative the
+engine rejected.  Writes VMAP_PENALTY.json.
+"""
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from fedtorch_tpu.config import (  # noqa: E402
+    DataConfig, ExperimentConfig, FederatedConfig, MeshConfig, ModelConfig,
+    OptimConfig,
+)
+from fedtorch_tpu.models import define_model  # noqa: E402
+from fedtorch_tpu.utils import enable_compile_cache  # noqa: E402
+
+K_CLIENTS, BATCH = 10, 50
+STEPS = 20
+
+
+def build_model(dtype="bfloat16"):
+    cfg = ExperimentConfig(
+        data=DataConfig(dataset="cifar10", batch_size=BATCH),
+        federated=FederatedConfig(federated=True, num_clients=K_CLIENTS),
+        model=ModelConfig(arch="resnet20"),
+        optim=OptimConfig(lr=0.1),
+        mesh=MeshConfig(compute_dtype=dtype),
+    ).finalize()
+    return define_model(cfg, batch_size=BATCH)
+
+
+def timeit(fn, *args):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.time()
+    for _ in range(STEPS):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.time() - t0) / STEPS
+
+
+def main():
+    model = build_model()
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(K_CLIENTS, BATCH, 32, 32, 3),
+                    jnp.float32)
+    y = jnp.asarray(rng.randint(0, 10, (K_CLIENTS, BATCH)))
+    params = model.init(jax.random.key(0))
+    kparams = jax.vmap(lambda _: params)(jnp.arange(K_CLIENTS))
+
+    def loss_fn(p, bx, by):
+        logits = model.apply(p, bx)
+        onehot = jax.nn.one_hot(by, logits.shape[-1])
+        return -jnp.mean(jnp.sum(
+            jax.nn.log_softmax(logits) * onehot, axis=-1))
+
+    grad_step = jax.grad(loss_fn)
+
+    @jax.jit
+    def shared(p, bx, by):
+        return grad_step(p, bx.reshape(-1, 32, 32, 3), by.reshape(-1))
+
+    @jax.jit
+    def vmapped(kp, bx, by):
+        return jax.vmap(grad_step)(kp, bx, by)
+
+    @jax.jit
+    def scanned(kp, bx, by):
+        def body(_, args):
+            return None, grad_step(*args)
+        return jax.lax.scan(body, None, (kp, bx, by))[1]
+
+    devs = jax.devices()
+    print(f"devices: {devs}", file=sys.stderr)
+    out = {"platform": devs[0].device_kind,
+           "config": {"clients": K_CLIENTS, "batch": BATCH,
+                      "model": "resnet20", "dtype": "bfloat16"},
+           "ms_per_step": {}}
+    for name, fn, p in (("shared", shared, params),
+                        ("vmapped", vmapped, kparams),
+                        ("scanned", scanned, kparams)):
+        dt = timeit(fn, p, x, y)
+        out["ms_per_step"][name] = round(dt * 1e3, 2)
+        print(f"{name:8s}: {dt*1e3:8.2f} ms for {K_CLIENTS}x{BATCH} "
+              "images fwd+bwd", file=sys.stderr)
+    out["vmap_penalty_x"] = round(
+        out["ms_per_step"]["vmapped"] / out["ms_per_step"]["shared"], 2)
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "VMAP_PENALTY.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out), file=sys.stderr)
+
+
+if __name__ == "__main__":
+    from bench import probe_device  # patient, wedge-aware relay probe
+
+    if not probe_device():
+        print("TPU relay unavailable; aborting without a number "
+              "(this micro-bench is only meaningful on the chip)",
+              file=sys.stderr)
+        sys.exit(1)
+    enable_compile_cache()
+    main()
