@@ -48,13 +48,14 @@ def probe_device(timeout_s: int = 120) -> bool:
     with a timeout: the TPU relay in this container can wedge
     indefinitely, and a hung bench is worse than a CPU fallback.
 
-    Retries a few times (BENCH_PROBE_TRIES, default 3) with a pause —
-    the relay's wedge clears on a server-side timeout, so patience at
-    bench time can be the difference between a real TPU number and a
-    CPU fallback."""
+    Retries a few times (BENCH_PROBE_TRIES, default 6) with a pause —
+    the relay's wedge clears on a server-side timeout (observed to take
+    tens of minutes), so patience at bench time is the difference
+    between a real TPU number and a CPU fallback. With the defaults the
+    probe gives the relay ~24 minutes to recover before giving up."""
     import subprocess
     import tempfile
-    tries = int(os.environ.get("BENCH_PROBE_TRIES", "3"))
+    tries = int(os.environ.get("BENCH_PROBE_TRIES", "6"))
     timeout_s = int(os.environ.get("BENCH_PROBE_TIMEOUT", timeout_s))
     for attempt in range(1, tries + 1):
         # stderr goes to a temp FILE, not a PIPE: a child emitting more

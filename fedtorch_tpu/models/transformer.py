@@ -93,13 +93,21 @@ class TransformerLM(nn.Module):
 
 
 def long_context_apply(module: TransformerLM, params, tokens, mesh,
-                       axis_name: str = "sp"):
-    """Forward with every attention block running exact ring attention,
-    the sequence axis sharded over ``mesh``'s ``axis_name``."""
-    from fedtorch_tpu.parallel.sequence import ring_attention
+                       axis_name: str = "sp", strategy: str = "ring"):
+    """Forward with every attention block running exact sequence-parallel
+    attention, the sequence axis sharded over ``mesh``'s ``axis_name``.
+
+    ``strategy``: 'ring' (K/V rotation, any head count) or 'ulysses'
+    (head-parallel all-to-all; needs heads % mesh size == 0) — see
+    parallel/sequence.py for the memory/ICI trade."""
+    from fedtorch_tpu.parallel.sequence import ring_attention, \
+        ulysses_attention
+
+    if strategy not in ("ring", "ulysses"):
+        raise ValueError(f"unknown sequence-parallel strategy {strategy!r}")
+    attn_fn = ring_attention if strategy == "ring" else ulysses_attention
 
     def attn(q, k, v):
-        return ring_attention(q, k, v, mesh, axis_name=axis_name,
-                              causal=True)
+        return attn_fn(q, k, v, mesh, axis_name=axis_name, causal=True)
 
     return module.apply({"params": params}, tokens, attn_override=attn)

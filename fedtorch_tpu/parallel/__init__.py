@@ -5,6 +5,9 @@ from fedtorch_tpu.parallel.federated import FederatedTrainer  # noqa: F401
 from fedtorch_tpu.parallel.local_sgd import (  # noqa: F401
     LocalSGDTrainer, build_local_sgd,
 )
+from fedtorch_tpu.parallel.sequence import (  # noqa: F401
+    reference_attention, ring_attention, ulysses_attention,
+)
 from fedtorch_tpu.parallel.mesh import (  # noqa: F401
     client_sharding, init_multihost, make_mesh, padded_client_count,
     replicate, replicated_sharding, shard_clients,

@@ -1,18 +1,29 @@
-"""Sequence/context parallelism: ring attention over the device mesh.
+"""Sequence/context parallelism over the device mesh: ring + all-to-all.
 
 The reference has no attention at all — its only sequence model is a
 char-GRU (SURVEY.md §5.7) — so long-context support is new, TPU-first
-scope: exact blockwise attention with the sequence axis sharded over a
-mesh axis and K/V blocks rotating around the ring via ``lax.ppermute``
-(one ICI hop per step, compute overlapped with the rotation by XLA's
-scheduler), in the style of Ring Attention (arXiv:2310.01889) with
-online-softmax accumulation (arXiv:2112.05682).
+scope. Two exact strategies share the [batch, seq, heads, head_dim]
+sequence-sharded layout:
+
+* :func:`ring_attention` — blockwise attention, K/V blocks rotating
+  around the ring via ``lax.ppermute`` (one ICI hop per step, compute
+  overlapped with the rotation by XLA's scheduler), in the style of Ring
+  Attention (arXiv:2310.01889) with online-softmax accumulation
+  (arXiv:2112.05682). Per-device score memory is one
+  [seq_local, seq_local] block per step — O(T^2/n^2) — and any head
+  count works.
+* :func:`ulysses_attention` — head-parallel all-to-all (DeepSpeed
+  Ulysses, arXiv:2309.14509): two all-to-alls re-shard sequence->heads
+  and back; fixed 2x-activation ICI volume regardless of sequence
+  length, but needs heads % mesh == 0 and holds full-sequence scores
+  for the local head slice — O(T^2 * H/n) per device.
 
 Layout: ``q, k, v: [batch, seq, heads, head_dim]`` with ``seq`` sharded
 over the ``sp`` mesh axis inside ``shard_map``. Each of the S ring steps
 processes the local Q block against one rotating K/V block, maintaining
 running (max, sum, accumulator) statistics, so the full [seq, seq] score
-matrix never materializes — memory is O(seq_local^2 / S) per device.
+matrix never materializes — score memory is one
+[seq_local, seq_local] block (O(T^2/n^2)) per device at a time.
 
 ``causal=True`` masks by absolute position, so the result is exactly
 standard causal attention regardless of sharding.
@@ -107,6 +118,61 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     spec = P(None, axis_name, None, None)
     shard_fn = jax.shard_map(
         functools.partial(_ring_attention_local, axis_name=axis_name,
+                          causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    sh = NamedSharding(mesh, spec)
+    q, k, v = (jax.device_put(x, sh) for x in (q, k, v))
+    return shard_fn(q, k, v)
+
+
+def _ulysses_local(q, k, v, *, axis_name: str, causal: bool,
+                   scale: float):
+    """Per-shard body: head-parallel attention via two all-to-alls.
+
+    In: [B, T/n, H, D] (sequence-sharded). First all-to-all re-shards to
+    [B, T, H/n, D] (head-sharded, full sequence), where plain causal
+    attention runs per head with NO inter-device traffic; the second
+    all-to-all restores sequence sharding. Total ICI volume is 2x the
+    activations — independent of sequence length — vs the ring's
+    (n-1) K/V rotations; the trade is all-to-all bandwidth against
+    score memory: full-T scores for the local head slice here
+    (O(T^2 * H/n)) vs the ring's per-step block (O(T^2/n^2))."""
+    # split heads (axis 2) across the mesh, concatenate sequence (axis 1)
+    q = jax.lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1,
+                           tiled=True)
+    k = jax.lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1,
+                           tiled=True)
+    v = jax.lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1,
+                           tiled=True)
+    o = reference_attention(q, k, v, causal=causal, scale=scale)
+    # inverse exchange: back to sequence-sharded, all heads
+    return jax.lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+
+def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      mesh: Mesh, axis_name: str = "sp",
+                      causal: bool = False,
+                      scale: Optional[float] = None) -> jnp.ndarray:
+    """Exact all-to-all (DeepSpeed-Ulysses-style, arXiv:2309.14509)
+    sequence parallelism: the alternative context-parallel strategy to
+    :func:`ring_attention`, preferred when head count >= mesh size and
+    per-device memory can hold full-sequence scores for its head slice
+    (the all-to-alls move a fixed 2x-activations volume over ICI instead
+    of rotating K/V n-1 times).
+
+    Inputs/outputs [batch, seq, heads, head_dim]; both ``seq`` and
+    ``heads`` must divide evenly over the mesh axis."""
+    n = mesh.shape[axis_name]
+    if q.shape[2] % n:
+        raise ValueError(
+            f"ulysses needs heads ({q.shape[2]}) divisible by the "
+            f"'{axis_name}' mesh axis ({n}); use ring_attention instead")
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    spec = P(None, axis_name, None, None)
+    shard_fn = jax.shard_map(
+        functools.partial(_ulysses_local, axis_name=axis_name,
                           causal=causal, scale=scale),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     sh = NamedSharding(mesh, spec)
