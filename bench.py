@@ -52,7 +52,8 @@ def probe_device(timeout_s: int = 120) -> bool:
     the relay's wedge clears on a server-side timeout (observed to take
     tens of minutes), so patience at bench time is the difference
     between a real TPU number and a CPU fallback. With the defaults the
-    probe gives the relay ~24 minutes to recover before giving up."""
+    probe gives the relay ~22 minutes (6x120s probes + 5x120s pauses)
+    to recover before giving up."""
     import subprocess
     import tempfile
     tries = int(os.environ.get("BENCH_PROBE_TRIES", "6"))

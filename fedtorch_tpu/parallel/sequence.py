@@ -105,6 +105,23 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
     return o / l_safe.transpose(0, 2, 1)[..., None]
 
 
+def _seq_sharded_call(local_fn, q, k, v, mesh: Mesh, axis_name: str,
+                      causal: bool, scale: Optional[float]):
+    """Shared wrapper for both strategies: default scale, shard the
+    sequence axis over ``axis_name``, run the per-shard body under
+    shard_map."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    spec = P(None, axis_name, None, None)
+    shard_fn = jax.shard_map(
+        functools.partial(local_fn, axis_name=axis_name, causal=causal,
+                          scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    sh = NamedSharding(mesh, spec)
+    q, k, v = (jax.device_put(x, sh) for x in (q, k, v))
+    return shard_fn(q, k, v)
+
+
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    mesh: Mesh, axis_name: str = "sp",
                    causal: bool = False,
@@ -113,16 +130,8 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
     Inputs/outputs [batch, seq, heads, head_dim]; seq must divide evenly
     over the mesh axis."""
-    if scale is None:
-        scale = 1.0 / math.sqrt(q.shape[-1])
-    spec = P(None, axis_name, None, None)
-    shard_fn = jax.shard_map(
-        functools.partial(_ring_attention_local, axis_name=axis_name,
-                          causal=causal, scale=scale),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
-    sh = NamedSharding(mesh, spec)
-    q, k, v = (jax.device_put(x, sh) for x in (q, k, v))
-    return shard_fn(q, k, v)
+    return _seq_sharded_call(_ring_attention_local, q, k, v, mesh,
+                             axis_name, causal, scale)
 
 
 def _ulysses_local(q, k, v, *, axis_name: str, causal: bool,
@@ -168,16 +177,8 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         raise ValueError(
             f"ulysses needs heads ({q.shape[2]}) divisible by the "
             f"'{axis_name}' mesh axis ({n}); use ring_attention instead")
-    if scale is None:
-        scale = 1.0 / math.sqrt(q.shape[-1])
-    spec = P(None, axis_name, None, None)
-    shard_fn = jax.shard_map(
-        functools.partial(_ulysses_local, axis_name=axis_name,
-                          causal=causal, scale=scale),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
-    sh = NamedSharding(mesh, spec)
-    q, k, v = (jax.device_put(x, sh) for x in (q, k, v))
-    return shard_fn(q, k, v)
+    return _seq_sharded_call(_ulysses_local, q, k, v, mesh, axis_name,
+                             causal, scale)
 
 
 def reference_attention(q, k, v, causal: bool = False,
