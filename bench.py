@@ -181,7 +181,11 @@ def main():
         model=ModelConfig(arch="resnet20"),
         optim=OptimConfig(lr=0.1, in_momentum=True),
         train=TrainConfig(local_step=LOCAL_STEPS),
-        mesh=MeshConfig(compute_dtype=dtype),
+        # BENCH_SCAN_UNROLL>1 lets XLA software-pipeline consecutive
+        # local steps (identical numerics, tested) for A/B on the chip
+        mesh=MeshConfig(compute_dtype=dtype,
+                        scan_unroll=int(os.environ.get(
+                            "BENCH_SCAN_UNROLL", "1"))),
     ).finalize()
 
     # CIFAR-10-shaped synthetic client shards (zero-egress container:

@@ -224,6 +224,11 @@ class MeshConfig:
     num_processes: Optional[int] = None
     process_id: Optional[int] = None
     compute_dtype: str = "float32"  # 'bfloat16' for MXU-friendly matmuls
+    # Unroll factor for the local-step scan: >1 lets XLA software-
+    # pipeline consecutive local steps (more instruction-level overlap,
+    # bigger program). Numerics are unchanged — the steps are data-
+    # dependent so unrolling cannot reorder the math.
+    scan_unroll: int = 1
 
 
 @dataclass(frozen=True)
@@ -293,6 +298,10 @@ class ExperimentConfig:
                              f"expected one of {FEDERATED_ALGORITHMS}")
         if data.dataset not in DATASETS:
             raise ValueError(f"Unknown dataset {data.dataset!r}")
+        if self.mesh.scan_unroll < 1:
+            raise ValueError(
+                f"mesh.scan_unroll must be >= 1, got "
+                f"{self.mesh.scan_unroll}")
 
         return dataclasses.replace(
             self, data=data, federated=fed, train=train, optim=optim)

@@ -359,7 +359,8 @@ class FederatedTrainer:
             init = (server_params, cstate.opt, cstate.aux, cstate.epoch,
                     cstate.local_index, carry0)
             (params, opt, aux, epoch, li, _), (losses, accs, act) = \
-                jax.lax.scan(step, init, jnp.arange(K))
+                jax.lax.scan(step, init, jnp.arange(K),
+                             unroll=min(self.cfg.mesh.scan_unroll, K))
 
             delta = tree_sub(server_params, params)
             lr_end = lr_at(self.schedule, epoch)

@@ -19,7 +19,8 @@ from fedtorch_tpu.parallel.federated import participation_indices
 
 def make_trainer(algorithm="fedavg", num_clients=8, rate=1.0, lr=0.1,
                  local_step=5, dataset="synthetic", arch="logistic_regression",
-                 **fed_kw):
+                 mesh_kw=None, **fed_kw):
+    from fedtorch_tpu.config import MeshConfig
     cfg = ExperimentConfig(
         data=DataConfig(dataset=dataset, synthetic_dim=20, batch_size=32,
                         synthetic_alpha=0.5, synthetic_beta=0.5),
@@ -30,6 +31,7 @@ def make_trainer(algorithm="fedavg", num_clients=8, rate=1.0, lr=0.1,
         model=ModelConfig(arch=arch),
         optim=OptimConfig(lr=lr, weight_decay=0.0),
         train=TrainConfig(local_step=local_step),
+        mesh=MeshConfig(**(mesh_kw or {})),
     ).finalize()
     data = build_federated_data(cfg)
     model = define_model(cfg, batch_size=cfg.data.batch_size)
@@ -180,6 +182,24 @@ class TestDeterminism:
         for a, b in zip(jax.tree.leaves(s1.params),
                         jax.tree.leaves(s2.params)):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestScanUnroll:
+    def test_unrolled_scan_matches_default(self):
+        """mesh.scan_unroll is a compile-time pipelining knob; the local
+        steps are data-dependent so unrolling must not change results."""
+        t1, _, _ = make_trainer(num_clients=4, rate=0.5, local_step=5)
+        t2, _, _ = make_trainer(num_clients=4, rate=0.5, local_step=5,
+                                mesh_kw={"scan_unroll": 5})
+        s1, c1 = t1.init_state(jax.random.key(3))
+        s2, c2 = t2.init_state(jax.random.key(3))
+        for _ in range(2):
+            s1, c1, _ = t1.run_round(s1, c1)
+            s2, c2, _ = t2.run_round(s2, c2)
+        for a, b in zip(jax.tree.leaves(s1.params),
+                        jax.tree.leaves(s2.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-6, rtol=1e-6)
 
 
 class TestMLPEngine:
