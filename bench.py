@@ -182,7 +182,7 @@ def main():
         optim=OptimConfig(lr=0.1, in_momentum=True),
         train=TrainConfig(local_step=LOCAL_STEPS),
         # BENCH_SCAN_UNROLL>1 lets XLA software-pipeline consecutive
-        # local steps (identical numerics, tested) for A/B on the chip
+        # local steps (tolerance-tested equivalent numerics) for A/B
         mesh=MeshConfig(compute_dtype=dtype,
                         scan_unroll=int(os.environ.get(
                             "BENCH_SCAN_UNROLL", "1"))),

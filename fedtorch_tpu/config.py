@@ -226,8 +226,9 @@ class MeshConfig:
     compute_dtype: str = "float32"  # 'bfloat16' for MXU-friendly matmuls
     # Unroll factor for the local-step scan: >1 lets XLA software-
     # pipeline consecutive local steps (more instruction-level overlap,
-    # bigger program). Numerics are unchanged — the steps are data-
-    # dependent so unrolling cannot reorder the math.
+    # bigger program). The data-dependent step order is preserved;
+    # results match the rolled scan to float tolerance (re-fusion of the
+    # unrolled body may shift last-ulp rounding).
     scan_unroll: int = 1
 
 

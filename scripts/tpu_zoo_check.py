@@ -7,9 +7,11 @@ actual TPU toolchain (mosaic/XLA-TPU) and executes one round on the
 chip. Catches real-lowering-only failures (e.g. the scoped-VMEM OOM the
 pallas quantize kernel hit at 2M elements, PALLAS_TPU.json).
 
-Also covers model families the MLP-only dryrun matrix does not: the
-char-GRU (shakespeare workload, explicit carry), the transformer LM,
-and bf16 ResNet-20 (the north-star arch).
+Also covers engine and model families the MLP-only dryrun matrix does
+not: the char-GRU (shakespeare workload, explicit carry), the
+transformer LM, bf16 ResNet-20 (the north-star arch), the non-federated
+local-SGD engine (`LocalSGDTrainer.fit`), and both sequence-parallel
+attention strategies on a 1-chip mesh.
 
 Writes TPU_ZOO.json; prints one JSON line.
 """
@@ -95,9 +97,43 @@ def _model_cases():
                    model_kw={"mlp_num_layers": 2,
                              "rnn_hidden_size": 32})
 
-    return [("resnet20_bf16", resnet_bf16),
-            ("rnn_gru_bf16", gru_shakespeare),
-            ("transformer_bf16", transformer_lm)]
+    def local_sgd():
+        # the non-federated data-parallel engine (distributed.py mode):
+        # two steps-per-sync rounds through LocalSGDTrainer.fit
+        from fedtorch_tpu.parallel import build_local_sgd
+
+        cfg = ExperimentConfig(
+            data=DataConfig(dataset="cifar10", batch_size=4),
+            federated=FederatedConfig(federated=False, num_clients=4),
+            model=ModelConfig(arch="cnn"),
+            optim=OptimConfig(lr=0.05, in_momentum=True),
+            train=TrainConfig(local_step=2, num_epochs=1),
+            mesh=MeshConfig(num_devices=1, compute_dtype="bfloat16"),
+        ).finalize()
+        feats = rng.randn(64, 32, 32, 3).astype(np.float32)
+        labels = rng.randint(0, 10, 64)
+        model = define_model(cfg, batch_size=4)
+        trainer = build_local_sgd(cfg, model, feats, labels)
+        _, _, history = trainer.fit(jax.random.key(0))
+        losses = [float(m.train_loss.sum()
+                        / max(float(m.online_mask.sum()), 1.0))
+                  for m in history]
+        return losses[-1]
+
+    def seqpar_single_chip():
+        # both sequence-parallel strategies lower through the real TPU
+        # toolchain (1-chip mesh: the collectives become no-ops but the
+        # shard_map program still compiles on mosaic/XLA-TPU); same
+        # check as the CPU-mesh dryrun, on real hardware
+        from __graft_entry__ import _run_sequence_parallel
+
+        return _run_sequence_parallel(1, label="tpu_zoo(1)")
+
+    return [("resnet20_bf16", resnet_bf16, "loss"),
+            ("rnn_gru_bf16", gru_shakespeare, "loss"),
+            ("transformer_bf16", transformer_lm, "loss"),
+            ("local_sgd_cnn_bf16", local_sgd, "loss"),
+            ("seqpar_1chip", seqpar_single_chip, "err")]
 
 
 def main():
@@ -127,16 +163,19 @@ def main():
             ok = False
             log(f"{name}: FAIL {str(e)[:200]}")
 
-    for name, fn in _model_cases():
+    for name, fn, kind in _model_cases():
         t0 = time.time()
         try:
-            loss = fn()
-            finite = loss == loss and abs(loss) != float("inf")
-            results["cases"][name] = {
-                "ok": bool(finite), "loss": round(loss, 4),
-                "secs": round(time.time() - t0, 1)}
+            val = fn()
+            finite = val == val and abs(val) != float("inf")
+            # "err" cases measure a numerical error bound (seqpar vs the
+            # dense oracle), not a training loss — keep full precision
+            rec = {"ok": bool(finite),
+                   kind: round(val, 4) if kind == "loss" else val,
+                   "secs": round(time.time() - t0, 1)}
+            results["cases"][name] = rec
             ok &= finite
-            log(f"{name}: loss {loss:.4f} ({time.time()-t0:.1f}s)")
+            log(f"{name}: {kind} {val:.4g} ({time.time()-t0:.1f}s)")
         except Exception as e:
             results["cases"][name] = {"ok": False,
                                       "error": str(e)[:300]}
