@@ -1,0 +1,42 @@
+#!/bin/bash
+# Wait for the TPU relay to recover, then capture the full measurement
+# list sequentially (each script writes its own artifact). Run from the
+# repo root, ideally in the background:
+#     nohup bash scripts/tpu_capture.sh > /tmp/tpu_capture.log 2>&1 &
+# The probe uses bench.probe_device (subprocess + SIGTERM-safe timeout);
+# TPU_CAPTURE_WAIT_TRIES probes x 120 s (+120 s pauses) bound the wait.
+set -u
+cd "$(dirname "$0")/.."
+
+TRIES="${TPU_CAPTURE_WAIT_TRIES:-90}"   # ~6 h of patience by default
+
+echo "[tpu_capture] waiting for the relay (up to ${TRIES}x120s probes)"
+BENCH_PROBE_TRIES="$TRIES" python - <<'EOF'
+import sys
+sys.path.insert(0, ".")
+from bench import probe_device
+sys.exit(0 if probe_device() else 1)
+EOF
+if [ $? -ne 0 ]; then
+    echo "[tpu_capture] relay never recovered; nothing captured"
+    exit 1
+fi
+
+echo "[tpu_capture] relay alive — capturing (each step sequential)"
+FAILED=0
+run() {
+    echo "=== $* ==="
+    # probes are already done; don't let per-script probes re-wait long
+    BENCH_PROBE_TRIES=2 "$@"
+    local rc=$?
+    echo "=== rc=$rc ==="
+    [ $rc -ne 0 ] && FAILED=1
+}
+
+run python bench.py
+run env BENCH_SCAN_UNROLL=4 python bench.py      # unroll A/B
+run python scripts/tpu_zoo_check.py              # -> TPU_ZOO.json
+run python scripts/vmap_penalty_bench.py         # -> VMAP_PENALTY.json
+run python scripts/baseline_suite.py             # -> BASELINE_SUITE.json
+echo "[tpu_capture] done (failed=$FAILED)"
+exit $FAILED

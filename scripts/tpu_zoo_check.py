@@ -32,6 +32,13 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def _mean_online_loss(metrics) -> float:
+    """Per-online-client mean train loss — the one loss definition every
+    case in this artifact reports."""
+    return float(metrics.train_loss.sum()
+                 / max(float(metrics.online_mask.sum()), 1.0))
+
+
 def _model_cases():
     """(name, cfg-builder) cases beyond the MLP zoo matrix."""
     import jax
@@ -70,9 +77,7 @@ def _model_cases():
         server, clients = trainer.init_state(jax.random.key(0))
         server, clients, m = trainer.run_round(server, clients)
         jax.block_until_ready(server.params)
-        # same normalization as the zoo loop: per-online-client mean
-        return float(m.train_loss.sum()
-                     / max(float(m.online_mask.sum()), 1.0))
+        return _mean_online_loss(m)
 
     rng = np.random.RandomState(3)
 
@@ -115,10 +120,7 @@ def _model_cases():
         model = define_model(cfg, batch_size=4)
         trainer = build_local_sgd(cfg, model, feats, labels)
         _, _, history = trainer.fit(jax.random.key(0))
-        losses = [float(m.train_loss.sum()
-                        / max(float(m.online_mask.sum()), 1.0))
-                  for m in history]
-        return losses[-1]
+        return _mean_online_loss(history[-1])
 
     def seqpar_single_chip():
         # both sequence-parallel strategies lower through the real TPU
@@ -149,8 +151,7 @@ def main():
         t0 = time.time()
         try:
             m = _run_zoo_case(name, fed_kw, trainer_kw, 1)
-            loss = float(m.train_loss.sum()
-                         / max(float(m.online_mask.sum()), 1.0))
+            loss = _mean_online_loss(m)
             finite = loss == loss and abs(loss) != float("inf")
             results["cases"][name] = {
                 "ok": bool(finite), "loss": round(loss, 4),
