@@ -8,6 +8,9 @@ from fedtorch_tpu.parallel.local_sgd import (  # noqa: F401
 from fedtorch_tpu.parallel.sequence import (  # noqa: F401
     reference_attention, ring_attention, ulysses_attention,
 )
+from fedtorch_tpu.parallel.tensor import (  # noqa: F401
+    tp_apply, transformer_tp_specs,
+)
 from fedtorch_tpu.parallel.mesh import (  # noqa: F401
     client_sharding, init_multihost, make_mesh, padded_client_count,
     replicate, replicated_sharding, shard_clients,

@@ -1,0 +1,84 @@
+"""Tensor parallelism for the transformer LM (GSPMD-style).
+
+The reference has no tensor parallelism at all (SURVEY.md §2.10: TP
+absent) — this is TPU-first new scope, done the XLA way: instead of
+hand-writing collectives, the param tree is annotated with Megatron-style
+``PartitionSpec``s over a ``tp`` mesh axis (column-parallel up-projections,
+row-parallel down-projections) and GSPMD inserts the all-reduces where the
+sharded matmuls meet. Composes with data parallelism on a 2-D
+``(dp, tp)`` mesh: activations shard their batch axis over ``dp``,
+weights shard over ``tp``, and XLA derives the rest.
+
+For sequence-length scaling use :mod:`fedtorch_tpu.parallel.sequence`
+(ring / ulysses attention); TP scales the MODEL dimension instead —
+the two address different memory walls.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# jitted forward per module (flax modules are hashable) — a fresh jit
+# closure per tp_apply call would retrace every invocation
+_FWD_CACHE: dict = {}
+
+
+def transformer_tp_specs(params, axis_name: str = "tp",
+                         mesh: Optional[Mesh] = None):
+    """Megatron-style PartitionSpec tree for a TransformerLM param tree.
+
+    * ``qkv`` / ``mlp_in`` kernels: column-parallel — output features
+      sharded, P(None, tp); their biases shard with the features.
+    * ``proj`` / ``mlp_out`` kernels: row-parallel — input features
+      sharded, P(tp, None); the subsequent all-reduce is GSPMD's to
+      insert.
+    * embeddings, layer norms, the LM head: replicated.
+
+    When ``mesh`` is given, any leaf whose sharded dimension does not
+    divide the ``axis_name`` size falls back to replicated (device_put
+    placement requires even splits)."""
+    col = {"qkv", "mlp_in"}
+    row = {"proj", "mlp_out"}
+    n = mesh.shape[axis_name] if mesh is not None else 1
+
+    def divides(leaf, dim):
+        return leaf.shape[dim] % n == 0
+
+    def spec_for(path, leaf):
+        names = [getattr(p, "key", None) for p in path]
+        owner = next((n_ for n_ in names if n_ in col | row), None)
+        field = names[-1]
+        if owner in col:
+            if field == "kernel" and divides(leaf, 1):
+                return P(None, axis_name)
+            if field == "bias" and divides(leaf, 0):
+                return P(axis_name)
+        if owner in row and field == "kernel" and divides(leaf, 0):
+            return P(axis_name, None)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def tp_apply(module, params, tokens, mesh: Mesh,
+             axis_name: str = "tp", dp_axis: Optional[str] = None):
+    """Forward with weights tensor-parallel over ``axis_name`` (and the
+    batch optionally data-parallel over ``dp_axis`` of a 2-D mesh).
+
+    Pure GSPMD: parameters are placed with the Megatron specs from
+    :func:`transformer_tp_specs`, tokens with P(dp) (or replicated), and
+    the jitted forward lets XLA partition the matmuls and insert the
+    row-parallel all-reduces. Results match the unsharded forward to
+    float tolerance."""
+    specs = transformer_tp_specs(params, axis_name, mesh=mesh)
+    p_sharded = jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params, specs)
+    tok_spec = P(dp_axis) if dp_axis else P()
+    toks = jax.device_put(tokens, NamedSharding(mesh, tok_spec))
+    if module not in _FWD_CACHE:
+        _FWD_CACHE[module] = jax.jit(
+            lambda p, t: module.apply({"params": p}, t))
+    return _FWD_CACHE[module](p_sharded, toks)

@@ -6,7 +6,7 @@
 # The probe uses bench.probe_device (subprocess + SIGTERM-safe timeout);
 # TPU_CAPTURE_WAIT_TRIES probes x 120 s (+120 s pauses) bound the wait.
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/.." || exit 1
 
 TRIES="${TPU_CAPTURE_WAIT_TRIES:-90}"   # ~6 h of patience by default
 
