@@ -1,0 +1,140 @@
+"""Pipeline parallelism for the transformer LM (GPipe-style).
+
+The reference has no pipeline parallelism (SURVEY.md §2.10: PP absent) —
+this is TPU-first new scope. The transformer's blocks are homogeneous,
+so their params stack into one ``[num_layers, ...]`` pytree; a ``pp``
+mesh axis holds ``num_layers / S`` consecutive blocks per device, and a
+fill/drain microbatch schedule rotates activations stage-to-stage with
+``lax.ppermute`` (one ICI hop per tick — the classic GPipe bubble of
+(S-1)/(M+S-1) idle ticks, amortized by more microbatches M).
+
+Embeddings and the LM head are computed replicated outside the pipelined
+region (they are O(vocab·d) — small next to the blocks).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from fedtorch_tpu.models.transformer import TransformerLM, _Block
+
+# jitted pipelined forward per (module, mesh, axis, microbatches) — a
+# fresh shard_map trace per call would retrace every invocation
+_PIPE_CACHE: dict = {}
+
+
+def stack_block_params(params, num_layers: int):
+    """Stack per-block param trees into leaves with a leading
+    [num_layers] axis (blocks are structurally identical)."""
+    blocks = [params[f"block_{i}"] for i in range(num_layers)]
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
+
+
+def _pipeline_local(staged, x_mbs, *, block_mod, axis_name: str,
+                    num_stages: int, num_microbatches: int):
+    """Per-stage body (inside shard_map).
+
+    staged: this stage's blocks, leaves [1, Lp, ...]; x_mbs: the embedded
+    microbatches [M, Bm, T, D] (replicated input). Returns the pipeline
+    output [M, Bm, T, D], identical on every stage (masked psum)."""
+    S, M = num_stages, num_microbatches
+    idx = jax.lax.axis_index(axis_name)
+    my_blocks = jax.tree.map(lambda x: x[0], staged)  # [Lp, ...]
+
+    def apply_stage(x):
+        def body(c, block_p):
+            return block_mod.apply({"params": block_p}, c), None
+
+        out, _ = jax.lax.scan(body, x, my_blocks)
+        return out
+
+    # initial carries must carry shard_map's varying-axis type (the loop
+    # writes stage-varying values into them); derive them from idx so
+    # they are 'varying' like the tick outputs (cf. sequence.py:77-79)
+    vary0 = (idx * 0).astype(x_mbs.dtype)
+    zeros = jnp.zeros(x_mbs.shape[1:], x_mbs.dtype) + vary0
+    outputs0 = jnp.zeros_like(x_mbs) + vary0
+
+    def tick(carry, t):
+        received, outputs = carry
+        # stage 0 feeds microbatch t during the fill window; later
+        # stages consume what the previous stage sent last tick
+        mb_in = x_mbs[jnp.clip(t, 0, M - 1)]
+        inp = jnp.where(idx == 0, mb_in, received)
+        out = apply_stage(inp)
+        # the LAST stage finishes microbatch (t - (S-1)) on this tick
+        mb_done = t - (S - 1)
+        valid = (mb_done >= 0) & (mb_done < M) & (idx == S - 1)
+        slot = jnp.clip(mb_done, 0, M - 1)
+        outputs = outputs.at[slot].set(
+            jnp.where(valid, out, outputs[slot]))
+        # rotate stage outputs forward; stage 0 receives zeros (unused)
+        perm = [(i, i + 1) for i in range(S - 1)]
+        received = jax.lax.ppermute(out, axis_name, perm) \
+            if S > 1 else zeros
+        return (received, outputs), None
+
+    (_, outputs), _ = jax.lax.scan(tick, (zeros, outputs0),
+                                   jnp.arange(M + S - 1))
+    # replicate the last stage's outputs to every device so the
+    # shard_map out_spec can be P() (replicated)
+    is_last = (idx == S - 1).astype(outputs.dtype)
+    return jax.lax.psum(outputs * is_last, axis_name)
+
+
+def pipeline_apply(module: TransformerLM, params, tokens, mesh: Mesh,
+                   axis_name: str = "pp",
+                   num_microbatches: Optional[int] = None):
+    """Forward with the transformer blocks pipelined over ``axis_name``.
+
+    ``num_layers`` must divide evenly over the mesh axis and the batch
+    over ``num_microbatches`` (default: the stage count). Exact: equals
+    the dense forward to float tolerance."""
+    S = mesh.shape[axis_name]
+    L = module.num_layers
+    if L % S:
+        raise ValueError(f"pipeline needs num_layers ({L}) divisible by "
+                         f"the '{axis_name}' mesh axis ({S})")
+    M = num_microbatches or max(S, 1)
+    B, T = tokens.shape
+    if B % M:
+        raise ValueError(f"batch ({B}) must divide into "
+                         f"{M} microbatches")
+
+    key = (module, mesh, axis_name, M)
+    if key not in _PIPE_CACHE:
+        block_mod = _Block(module.num_heads, dtype=module.dtype)
+        local = functools.partial(
+            _pipeline_local, block_mod=block_mod, axis_name=axis_name,
+            num_stages=S, num_microbatches=M)
+        spec = P(axis_name)
+
+        def fwd(params, tokens):
+            dt = jnp.dtype(module.dtype)
+            # replicated pre/post stages apply the model's own
+            # submodules, so the pipelined forward cannot drift from
+            # TransformerLM.__call__ (transformer.py:83-92)
+            x = nn.Embed(module.vocab_size, module.d_model).apply(
+                {"params": params["tok_embed"]}, tokens).astype(dt)
+            x = x + params["pos_embed"][:tokens.shape[1]].astype(dt)
+            x_mbs = x.reshape(M, tokens.shape[0] // M, *x.shape[1:])
+            stacked = stack_block_params(params, L)
+            staged = jax.tree.map(
+                lambda a: a.reshape((S, L // S) + a.shape[1:]), stacked)
+            staged_specs = jax.tree.map(lambda _: spec, staged)
+            out = jax.shard_map(local, mesh=mesh,
+                                in_specs=(staged_specs, P()),
+                                out_specs=P())(staged, x_mbs)
+            x = out.reshape(*tokens.shape, -1)
+            x = nn.LayerNorm(dtype=jnp.float32).apply(
+                {"params": params["ln_f"]}, x)
+            return nn.Dense(module.vocab_size).apply(
+                {"params": params["head"]}, x)
+
+        _PIPE_CACHE[key] = jax.jit(fwd)
+    return _PIPE_CACHE[key](params, tokens)
