@@ -49,10 +49,64 @@ class _SelfAttention(nn.Module):
                         name="proj")(out)
 
 
+class MoEMLP(nn.Module):
+    """Top-1-gated mixture-of-experts MLP (Switch-style routing,
+    arXiv:2101.03961) with capacity = all tokens: dispatch is a dense
+    one-hot einsum, so routing is exact (no token dropping) and the
+    layer equals an ordinary MLP when num_experts == 1. Expert weights
+    carry a leading [E] axis — the axis expert parallelism shards
+    (parallel/expert.py)."""
+    num_experts: int
+    mlp_ratio: int = 4
+    dtype: str = "float32"
+
+    @nn.compact
+    def __call__(self, x):
+        dt = jnp.dtype(self.dtype)
+        d = x.shape[-1]
+        E, hidden = self.num_experts, self.mlp_ratio * d
+        logits = nn.Dense(E, use_bias=False, name="gate")(
+            x.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)
+        top_p = jnp.max(probs, axis=-1)                     # [B, T]
+        sel = jnp.argmax(probs, axis=-1)                    # [B, T]
+        onehot = jax.nn.one_hot(sel, E, dtype=dt)           # [B, T, E]
+        # batch_axis=0: E is a vmap-like expert axis, not a fan —
+        # each expert initializes like an ordinary Dense (std 1/sqrt(d))
+        w_in = self.param("w_in",
+                          nn.initializers.lecun_normal(batch_axis=0),
+                          (E, d, hidden)).astype(dt)
+        b_in = self.param("b_in", nn.initializers.zeros,
+                          (E, hidden)).astype(dt)
+        w_out = self.param("w_out",
+                           nn.initializers.lecun_normal(batch_axis=0),
+                           (E, hidden, d)).astype(dt)
+        b_out = self.param("b_out", nn.initializers.zeros,
+                           (E, d)).astype(dt)
+        out = moe_expert_compute(x.astype(dt), onehot, w_in, b_in,
+                                 w_out, b_out)
+        return out * top_p[..., None].astype(dt)
+
+
+def moe_expert_compute(x, onehot, w_in, b_in, w_out, b_out):
+    """The expert dispatch -> MLP -> combine core, shared verbatim by
+    the single-device module above and the expert-parallel shard body
+    (parallel/expert.py) so the two cannot drift. Binary dispatch;
+    the caller applies the gate-probability scaling."""
+    dispatch = jnp.einsum("bte,btd->ebtd", onehot, x)
+    h = jax.nn.gelu(
+        jnp.einsum("ebtd,edf->ebtf", dispatch, w_in)
+        + b_in[:, None, None])
+    y = jnp.einsum("ebtf,efd->ebtd", h, w_out) + b_out[:, None, None]
+    # combine: each token reads back its own expert's row
+    return jnp.einsum("ebtd,bte->btd", y, onehot)
+
+
 class _Block(nn.Module):
     num_heads: int
     mlp_ratio: int = 4
     dtype: str = "float32"
+    num_experts: int = 0  # 0 = dense MLP; >0 = MoE (Switch top-1)
 
     @nn.compact
     def __call__(self, x, attn_override=None):
@@ -61,6 +115,9 @@ class _Block(nn.Module):
         x = x + _SelfAttention(self.num_heads, self.dtype,
                                name="attn")(h, attn_override)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(dt)
+        if self.num_experts > 0:
+            return x + MoEMLP(self.num_experts, self.mlp_ratio,
+                              self.dtype, name="moe")(h)
         h = nn.Dense(self.mlp_ratio * x.shape[-1], dtype=dt,
                      name="mlp_in")(h)
         h = nn.gelu(h)
@@ -75,6 +132,7 @@ class TransformerLM(nn.Module):
     num_layers: int = 2
     max_len: int = 2048
     dtype: str = "float32"
+    num_experts: int = 0  # >0 swaps every block's MLP for a Switch MoE
 
     @nn.compact
     def __call__(self, tokens, train: bool = False, attn_override=None):
@@ -87,6 +145,7 @@ class TransformerLM(nn.Module):
         x = x + pos[:t_len].astype(dt)
         for i in range(self.num_layers):
             x = _Block(self.num_heads, dtype=self.dtype,
+                       num_experts=self.num_experts,
                        name=f"block_{i}")(x, attn_override)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         return nn.Dense(self.vocab_size, name="head")(x)

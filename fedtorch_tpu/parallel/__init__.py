@@ -12,6 +12,7 @@ from fedtorch_tpu.parallel.tensor import (  # noqa: F401
     tp_apply, transformer_tp_specs,
 )
 from fedtorch_tpu.parallel.pipeline import pipeline_apply  # noqa: F401
+from fedtorch_tpu.parallel.expert import ep_moe_apply  # noqa: F401
 from fedtorch_tpu.parallel.mesh import (  # noqa: F401
     client_sharding, init_multihost, make_mesh, padded_client_count,
     replicate, replicated_sharding, shard_clients,

@@ -1,0 +1,72 @@
+"""Expert parallelism for the MoE transformer layer.
+
+The reference has no MoE or expert parallelism (SURVEY.md §2.10: EP
+absent) — TPU-first new scope. The ``MoEMLP`` layer
+(models/transformer.py) keeps its expert weights on a leading ``[E]``
+axis; here that axis shards over an ``ep`` mesh axis: every device
+computes the dispatch -> expert-MLP -> combine core
+(``moe_expert_compute``, shared verbatim with the single-device module
+so the two cannot drift) for ITS experts only, and one ``psum`` merges
+the per-expert partial combines — each token's row is non-zero on
+exactly the device owning its routed expert, so the sum IS the routed
+output. Gating runs replicated (it is O(d·E) — tiny).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from fedtorch_tpu.models.transformer import moe_expert_compute
+
+# jitted expert-parallel layer per (mesh, axis, dtype) — signature-level
+# cache; shapes re-trace under the same jit entry as usual
+_EP_CACHE: dict = {}
+
+
+def ep_moe_apply(params, x, mesh: Mesh, axis_name: str = "ep"):
+    """Run one MoEMLP layer with its experts sharded over ``axis_name``.
+
+    ``params`` is the layer's param dict ({gate, w_in, b_in, w_out,
+    w_out, b_out}); ``x`` is [B, T, D]. Exact: equals
+    ``MoEMLP.apply`` to float tolerance."""
+    E = params["w_in"].shape[0]
+    n = mesh.shape[axis_name]
+    if E % n:
+        raise ValueError(f"expert parallelism needs num_experts ({E}) "
+                         f"divisible by the '{axis_name}' mesh axis "
+                         f"({n})")
+    key = (mesh, axis_name, x.dtype, E)
+    if key not in _EP_CACHE:
+        espec = P(axis_name)
+
+        def fwd(params, x):
+            logits = x.astype(jnp.float32) @ params["gate"]["kernel"]
+            probs = jax.nn.softmax(logits, axis=-1)
+            top_p = jnp.max(probs, axis=-1)
+            onehot = jax.nn.one_hot(jnp.argmax(probs, axis=-1), E,
+                                    dtype=x.dtype)
+
+            def local(w_in, b_in, w_out, b_out, oh, x_rep):
+                # oh: [B, T, E/n] — this device's expert columns; the
+                # shared core then dispatches/combines only tokens
+                # routed here, zero rows elsewhere
+                y = moe_expert_compute(x_rep, oh, w_in, b_in, w_out,
+                                       b_out)
+                return jax.lax.psum(y, axis_name)
+
+            out = jax.shard_map(
+                local, mesh=mesh,
+                in_specs=(espec, espec, espec, espec,
+                          P(None, None, axis_name), P()),
+                out_specs=P())(
+                params["w_in"].astype(x.dtype),
+                params["b_in"].astype(x.dtype),
+                params["w_out"].astype(x.dtype),
+                params["b_out"].astype(x.dtype), onehot, x)
+            return out * top_p[..., None].astype(x.dtype)
+
+        _EP_CACHE[key] = jax.jit(fwd)
+    return _EP_CACHE[key](params, x)

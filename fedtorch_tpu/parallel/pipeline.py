@@ -108,7 +108,8 @@ def pipeline_apply(module: TransformerLM, params, tokens, mesh: Mesh,
 
     key = (module, mesh, axis_name, M)
     if key not in _PIPE_CACHE:
-        block_mod = _Block(module.num_heads, dtype=module.dtype)
+        block_mod = _Block(module.num_heads, dtype=module.dtype,
+                           num_experts=module.num_experts)
         local = functools.partial(
             _pipeline_local, block_mod=block_mod, axis_name=axis_name,
             num_stages=S, num_microbatches=M)
