@@ -92,6 +92,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--rnn_seq_len", type=int, default=50)
     p.add_argument("--rnn_hidden_size", type=int, default=50)
     p.add_argument("--vocab_size", type=int, default=86)
+    p.add_argument("--moe_experts", type=int, default=0,
+                   help="transformer arch: >0 swaps block MLPs for a "
+                        "Switch-MoE with this many experts")
     # training scheme (parameters.py:118-141)
     p.add_argument("--stop_criteria", default="epoch")
     p.add_argument("--num_epochs", type=int, default=None)
@@ -212,7 +215,8 @@ def args_to_config(args) -> ExperimentConfig:
             mlp_hidden_size=args.mlp_hidden_size,
             rnn_seq_len=args.rnn_seq_len,
             rnn_hidden_size=args.rnn_hidden_size,
-            vocab_size=args.vocab_size),
+            vocab_size=args.vocab_size,
+            moe_experts=args.moe_experts),
         optim=OptimConfig(
             optimizer=args.optimizer, lr=args.lr,
             in_momentum=args.in_momentum,

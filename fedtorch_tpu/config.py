@@ -123,6 +123,9 @@ class ModelConfig:
     rnn_seq_len: int = 50
     rnn_hidden_size: int = 50
     vocab_size: int = 86
+    # transformer arch only: >0 swaps each block's MLP for a Switch-MoE
+    # with this many experts (expert-parallel over the mesh when sharded)
+    moe_experts: int = 0
     pretrained: bool = False
     # 'robust_*' archs learn an adversarial input-noise parameter.
     robust_noise_ascent_lr: float = 0.1

@@ -123,7 +123,8 @@ def define_model(cfg: ExperimentConfig, batch_size: int = 2) -> ModelDef:
         module = TransformerLM(vocab_size=m.vocab_size, d_model=d_model,
                                num_heads=num_heads,
                                num_layers=m.mlp_num_layers,
-                               dtype=cfg.mesh.compute_dtype)
+                               dtype=cfg.mesh.compute_dtype,
+                               num_experts=m.moe_experts)
         sample = jnp.zeros((batch_size, m.rnn_seq_len), jnp.int32)
         return ModelDef(arch, module, sample)
     raise ValueError(f"Unknown architecture {arch!r}")

@@ -62,3 +62,41 @@ class TestExpertParallel:
         mesh = Mesh(np.asarray(jax.devices()[:4]), ("ep",))
         with pytest.raises(ValueError, match="divisible"):
             ep_moe_apply(params, x, mesh)
+
+
+def test_federated_moe_via_config_surface():
+    """moe_experts threads from ModelConfig through define_model into a
+    federated round (the CLI path)."""
+    from fedtorch_tpu.algorithms import make_algorithm
+    from fedtorch_tpu.config import (
+        DataConfig, ExperimentConfig, FederatedConfig, MeshConfig,
+        ModelConfig, OptimConfig, TrainConfig,
+    )
+    from fedtorch_tpu.data.batching import stack_partitions
+    from fedtorch_tpu.models import define_model
+    from fedtorch_tpu.parallel import FederatedTrainer
+
+    rng = np.random.RandomState(3)
+    x = rng.randint(0, 86, (32, 16)).astype(np.int32)
+    y = np.roll(x, -1, axis=1).astype(np.int32)
+    parts = [np.arange(i * 8, (i + 1) * 8) for i in range(4)]
+    data = stack_partitions(x, y, parts)
+    cfg = ExperimentConfig(
+        data=DataConfig(dataset="shakespeare", batch_size=4),
+        federated=FederatedConfig(
+            federated=True, num_clients=4, online_client_rate=1.0,
+            algorithm="fedavg", sync_type="local_step"),
+        model=ModelConfig(arch="transformer", mlp_num_layers=1,
+                          rnn_seq_len=16, rnn_hidden_size=8,
+                          moe_experts=2),
+        optim=OptimConfig(lr=0.05, weight_decay=0.0),
+        train=TrainConfig(local_step=2),
+        mesh=MeshConfig(num_devices=1),
+    ).finalize()
+    model = define_model(cfg, batch_size=4)
+    assert "moe" in model.init(jax.random.key(0))["block_0"]
+    trainer = FederatedTrainer(cfg, model, make_algorithm(cfg), data)
+    server, clients = trainer.init_state(jax.random.key(0))
+    server, clients, m = trainer.run_round(server, clients)
+    loss = float(m.train_loss.sum() / m.online_mask.sum())
+    assert np.isfinite(loss)
