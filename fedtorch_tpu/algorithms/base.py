@@ -145,14 +145,22 @@ class FedAlgorithm:
         Returns (params, opt, client_aux, rnn_carry, loss, acc)."""
         model, criterion, cfg = self.model, self.criterion, self.cfg
 
+        moe_w = cfg.model.moe_aux_weight
+
         def loss_fn(p):
+            aux_reg = jnp.asarray(0.0)
             if model.is_recurrent:
                 logits, new_rnn = model.apply(p, bx, train=True, rng=rng,
                                               carry=rnn_carry)
             else:
-                logits = model.apply(p, bx, train=True, rng=rng)
                 new_rnn = rnn_carry
-            loss = criterion(logits, by)
+                if model.has_aux_loss and moe_w > 0:
+                    logits, aux = model.apply_with_aux(
+                        p, bx, train=True, rng=rng)
+                    aux_reg = moe_w * aux
+                else:
+                    logits = model.apply(p, bx, train=True, rng=rng)
+            loss = criterion(logits, by) + aux_reg
             loss = loss + self.extra_loss(p, server_params, client_aux)
             return loss, (logits, new_rnn)
 

@@ -94,7 +94,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--vocab_size", type=int, default=86)
     p.add_argument("--moe_experts", type=int, default=0,
                    help="transformer arch: >0 swaps block MLPs for a "
-                        "Switch-MoE with this many experts")
+                        "Switch-MoE with this many experts. With "
+                        "--moe_capacity_factor 0 dispatch is exact but "
+                        "costs E x the dense MLP FLOPs")
+    p.add_argument("--moe_capacity_factor", type=float, default=0.0,
+                   help="0 = exact dense MoE dispatch (E x FLOPs); >0 "
+                        "= sparse Switch dispatch, per-expert capacity "
+                        "ceil(cf*tokens/E), cf x FLOPs, over-capacity "
+                        "tokens drop to the residual (try 1.25)")
+    p.add_argument("--moe_aux_weight", type=float, default=0.0,
+                   help="Switch load-balance aux-loss weight (0.01 in "
+                        "the paper); 0 disables and the gate can "
+                        "collapse onto one expert")
     # training scheme (parameters.py:118-141)
     p.add_argument("--stop_criteria", default="epoch")
     p.add_argument("--num_epochs", type=int, default=None)
@@ -216,7 +227,9 @@ def args_to_config(args) -> ExperimentConfig:
             rnn_seq_len=args.rnn_seq_len,
             rnn_hidden_size=args.rnn_hidden_size,
             vocab_size=args.vocab_size,
-            moe_experts=args.moe_experts),
+            moe_experts=args.moe_experts,
+            moe_capacity_factor=args.moe_capacity_factor,
+            moe_aux_weight=args.moe_aux_weight),
         optim=OptimConfig(
             optimizer=args.optimizer, lr=args.lr,
             in_momentum=args.in_momentum,

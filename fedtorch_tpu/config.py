@@ -126,6 +126,15 @@ class ModelConfig:
     # transformer arch only: >0 swaps each block's MLP for a Switch-MoE
     # with this many experts (expert-parallel over the mesh when sharded)
     moe_experts: int = 0
+    # MoE dispatch: 0 = exact dense one-hot dispatch (no drops, costs E×
+    # the dense MLP FLOPs — oracle/testing mode); >0 = sparse Switch
+    # dispatch with per-expert capacity ceil(cf·tokens/E) (costs cf× the
+    # dense MLP FLOPs; over-capacity tokens drop to the residual)
+    moe_capacity_factor: float = 0.0
+    # Switch load-balancing auxiliary loss weight (arXiv:2101.03961
+    # §2.2; paper default 0.01). 0 disables; without it the top-1 gate
+    # can collapse onto one expert.
+    moe_aux_weight: float = 0.0
     pretrained: bool = False
     # 'robust_*' archs learn an adversarial input-noise parameter.
     robust_noise_ascent_lr: float = 0.1

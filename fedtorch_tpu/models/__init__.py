@@ -124,7 +124,9 @@ def define_model(cfg: ExperimentConfig, batch_size: int = 2) -> ModelDef:
                                num_heads=num_heads,
                                num_layers=m.mlp_num_layers,
                                dtype=cfg.mesh.compute_dtype,
-                               num_experts=m.moe_experts)
+                               num_experts=m.moe_experts,
+                               capacity_factor=m.moe_capacity_factor)
         sample = jnp.zeros((batch_size, m.rnn_seq_len), jnp.int32)
-        return ModelDef(arch, module, sample)
+        return ModelDef(arch, module, sample,
+                        has_aux_loss=m.moe_experts > 0)
     raise ValueError(f"Unknown architecture {arch!r}")

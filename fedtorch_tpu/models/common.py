@@ -146,6 +146,10 @@ class ModelDef(NamedTuple):
     is_recurrent: bool = False
     is_regression: bool = False
     has_noise_param: bool = False  # robust_* adversarial input noise
+    # model sows regularizers into the 'aux_loss' collection (MoE
+    # load-balance); consumed via apply_with_aux when the config weight
+    # is non-zero, silently discarded by plain apply
+    has_aux_loss: bool = False
 
     def init(self, rng) -> Any:
         rngs = {"params": rng, "dropout": jax.random.fold_in(rng, 1)}
@@ -160,6 +164,18 @@ class ModelDef(NamedTuple):
         if self.is_recurrent:
             return self.module.apply({"params": params}, x, carry, rngs=rngs)
         return self.module.apply({"params": params}, x, rngs=rngs, **kwargs)
+
+    def apply_with_aux(self, params, x, train: bool = False, rng=None):
+        """Forward returning ``(logits, aux)`` where ``aux`` is the SUM
+        of everything the model sowed into the 'aux_loss' collection
+        (Switch sums the per-layer load-balance losses, arXiv:2101.03961
+        §2.2). Feed-forward models only."""
+        rngs = {"dropout": rng} if rng is not None else None
+        out, var = self.module.apply({"params": params}, x, rngs=rngs,
+                                     train=train, mutable=["aux_loss"])
+        leaves = jax.tree.leaves(var.get("aux_loss", {}))
+        aux = sum(leaves) if leaves else jnp.asarray(0.0)
+        return out, aux
 
     def init_carry(self, batch_size: int):
         if not self.is_recurrent:

@@ -10,10 +10,29 @@ per-block dense attention for the exact ring attention of
 
 Pre-norm blocks, learned positional embeddings, GELU MLP; compute dtype
 configurable like the rest of the zoo (params/norm-statistics in f32).
+
+MoE blocks (Switch-style top-1 routing, arXiv:2101.03961) support two
+dispatch modes:
+
+* ``capacity_factor == 0`` — exact dense dispatch: every expert sees all
+  tokens through a one-hot einsum. No token dropping, bit-stable oracle,
+  but costs E× the dense MLP FLOPs — fine for tests/small E, wrong for
+  scale.
+* ``capacity_factor > 0`` — sparse dispatch: each expert processes at
+  most ``C = ceil(cf · tokens / E)`` tokens via static-shape
+  gather/scatter, so the MLP FLOPs are ``cf×`` the dense MLP cost
+  (independent of E). Tokens over capacity are dropped (their MoE branch
+  contributes 0 and the residual passes through — Switch §2.2 semantics).
+
+Both modes sow the Switch load-balancing auxiliary loss into the
+``aux_loss`` collection and per-expert routing fractions into
+``intermediates`` (see :func:`routing_fractions`).
 """
 from __future__ import annotations
 
 import math
+from typing import Optional
+
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
@@ -51,14 +70,13 @@ class _SelfAttention(nn.Module):
 
 class MoEMLP(nn.Module):
     """Top-1-gated mixture-of-experts MLP (Switch-style routing,
-    arXiv:2101.03961) with capacity = all tokens: dispatch is a dense
-    one-hot einsum, so routing is exact (no token dropping) and the
-    layer equals an ordinary MLP when num_experts == 1. Expert weights
-    carry a leading [E] axis — the axis expert parallelism shards
-    (parallel/expert.py)."""
+    arXiv:2101.03961). Expert weights carry a leading [E] axis — the
+    axis expert parallelism shards (parallel/expert.py). Dispatch mode
+    per ``capacity_factor`` (module docstring)."""
     num_experts: int
     mlp_ratio: int = 4
     dtype: str = "float32"
+    capacity_factor: float = 0.0  # 0 = exact dense dispatch
 
     @nn.compact
     def __call__(self, x):
@@ -70,7 +88,6 @@ class MoEMLP(nn.Module):
         probs = jax.nn.softmax(logits, axis=-1)
         top_p = jnp.max(probs, axis=-1)                     # [B, T]
         sel = jnp.argmax(probs, axis=-1)                    # [B, T]
-        onehot = jax.nn.one_hot(sel, E, dtype=dt)           # [B, T, E]
         # batch_axis=0: E is a vmap-like expert axis, not a fan —
         # each expert initializes like an ordinary Dense (std 1/sqrt(d))
         w_in = self.param("w_in",
@@ -83,16 +100,36 @@ class MoEMLP(nn.Module):
                            (E, hidden, d)).astype(dt)
         b_out = self.param("b_out", nn.initializers.zeros,
                            (E, d)).astype(dt)
-        out = moe_expert_compute(x.astype(dt), onehot, w_in, b_in,
-                                 w_out, b_out)
+        # Switch §2.2 load-balance aux: E * sum_e f_e * P_e, where f_e =
+        # routed-token fraction, P_e = mean router prob. Differentiable
+        # through P; minimized (=1) by uniform routing. Sown so the
+        # engine adds it to the loss only when the collection is mutable
+        # (moe_aux_weight > 0) — plain applies discard it for free.
+        frac = jnp.mean(
+            jax.nn.one_hot(sel, E, dtype=jnp.float32), axis=(0, 1))
+        mean_p = jnp.mean(probs, axis=(0, 1))
+        self.sow("aux_loss", "load_balance",
+                 E * jnp.sum(frac * mean_p))
+        self.sow("intermediates", "expert_fraction", frac)
+        if self.capacity_factor > 0:
+            capacity = max(
+                1, math.ceil(self.capacity_factor * x.shape[0]
+                             * x.shape[1] / E))
+            out = moe_sparse_compute(x.astype(dt), sel, w_in, b_in,
+                                     w_out, b_out, capacity)
+        else:
+            onehot = jax.nn.one_hot(sel, E, dtype=dt)       # [B, T, E]
+            out = moe_expert_compute(x.astype(dt), onehot, w_in, b_in,
+                                     w_out, b_out)
         return out * top_p[..., None].astype(dt)
 
 
 def moe_expert_compute(x, onehot, w_in, b_in, w_out, b_out):
-    """The expert dispatch -> MLP -> combine core, shared verbatim by
-    the single-device module above and the expert-parallel shard body
-    (parallel/expert.py) so the two cannot drift. Binary dispatch;
-    the caller applies the gate-probability scaling."""
+    """The exact dense expert dispatch -> MLP -> combine core, shared
+    verbatim by the single-device module above and the expert-parallel
+    shard body (parallel/expert.py) so the two cannot drift. Binary
+    dispatch; the caller applies the gate-probability scaling. Costs E×
+    the dense MLP FLOPs (every expert runs every token)."""
     dispatch = jnp.einsum("bte,btd->ebtd", onehot, x)
     h = jax.nn.gelu(
         jnp.einsum("ebtd,edf->ebtf", dispatch, w_in)
@@ -102,11 +139,65 @@ def moe_expert_compute(x, onehot, w_in, b_in, w_out, b_out):
     return jnp.einsum("ebtd,bte->btd", y, onehot)
 
 
+def moe_dispatch_plan(sel, num_experts: int, capacity: int):
+    """Static-shape Switch dispatch plan for a routing decision.
+
+    ``sel`` [B, T] int expert ids -> (slot [N], keep [N],
+    token_for_slot [E*C]): token n occupies slot ``sel[n]*C + pos`` where
+    pos is its arrival order within its expert; tokens past capacity get
+    ``keep=False`` and the overflow slot E*C. ``token_for_slot`` inverts
+    the map (value N = empty slot). Shared by the module's sparse path
+    and the expert-parallel shard body (parallel/expert.py)."""
+    E, C = num_experts, capacity
+    sel_flat = sel.reshape(-1)
+    n_tokens = sel_flat.shape[0]
+    onehot = jax.nn.one_hot(sel_flat, E, dtype=jnp.int32)   # [N, E]
+    pos = (jnp.cumsum(onehot, axis=0) * onehot).sum(-1) - 1  # [N]
+    keep = pos < C
+    slot = jnp.where(keep, sel_flat * C + pos, E * C)
+    token_for_slot = jnp.full((E * C + 1,), n_tokens, jnp.int32)
+    token_for_slot = token_for_slot.at[slot].set(
+        jnp.arange(n_tokens, dtype=jnp.int32))
+    return slot, keep, token_for_slot[:E * C]
+
+
+def moe_expert_mlp(expert_in, w_in, b_in, w_out, b_out):
+    """The per-expert MLP on gathered token blocks [E', C, D] — the
+    single definition of the expert math for BOTH sparse dispatch paths
+    (module-local below and the expert-parallel shard body,
+    parallel/expert.py) so they cannot drift."""
+    h = jax.nn.gelu(
+        jnp.einsum("ecd,edf->ecf", expert_in, w_in) + b_in[:, None])
+    return jnp.einsum("ecf,efd->ecd", h, w_out) + b_out[:, None]
+
+
+def moe_sparse_compute(x, sel, w_in, b_in, w_out, b_out, capacity: int):
+    """Capacity-bounded Switch dispatch: gather each expert's routed
+    tokens into [E, C, D], run the expert MLPs as one batched matmul,
+    scatter results back. FLOPs = capacity_factor × the dense MLP cost.
+    Equals :func:`moe_expert_compute` exactly whenever no expert
+    overflows ``capacity``; overflowing tokens contribute 0 (dropped).
+    Caller applies the gate-probability scaling."""
+    B, T, D = x.shape
+    E = w_in.shape[0]
+    n_tokens = B * T
+    xf = x.reshape(n_tokens, D)
+    slot, _, token_for_slot = moe_dispatch_plan(sel, E, capacity)
+    xf_pad = jnp.concatenate([xf, jnp.zeros((1, D), xf.dtype)])
+    expert_in = xf_pad[token_for_slot].reshape(E, capacity, D)
+    y = moe_expert_mlp(expert_in, w_in, b_in, w_out, b_out)
+    y_pad = jnp.concatenate(
+        [y.reshape(E * capacity, D), jnp.zeros((1, D), y.dtype)])
+    # dropped tokens already carry the overflow slot E*C -> zero row
+    return y_pad[slot].reshape(B, T, D)
+
+
 class _Block(nn.Module):
     num_heads: int
     mlp_ratio: int = 4
     dtype: str = "float32"
     num_experts: int = 0  # 0 = dense MLP; >0 = MoE (Switch top-1)
+    capacity_factor: float = 0.0
 
     @nn.compact
     def __call__(self, x, attn_override=None):
@@ -117,7 +208,8 @@ class _Block(nn.Module):
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(dt)
         if self.num_experts > 0:
             return x + MoEMLP(self.num_experts, self.mlp_ratio,
-                              self.dtype, name="moe")(h)
+                              self.dtype, self.capacity_factor,
+                              name="moe")(h)
         h = nn.Dense(self.mlp_ratio * x.shape[-1], dtype=dt,
                      name="mlp_in")(h)
         h = nn.gelu(h)
@@ -133,22 +225,60 @@ class TransformerLM(nn.Module):
     max_len: int = 2048
     dtype: str = "float32"
     num_experts: int = 0  # >0 swaps every block's MLP for a Switch MoE
+    capacity_factor: float = 0.0  # MoE dispatch mode (module docstring)
 
-    @nn.compact
-    def __call__(self, tokens, train: bool = False, attn_override=None):
+    def setup(self):
+        self.tok_embed = nn.Embed(self.vocab_size, self.d_model,
+                                  name="tok_embed")
+        self.pos_embed = self.param("pos_embed",
+                                    nn.initializers.normal(0.02),
+                                    (self.max_len, self.d_model))
+        self.blocks = [
+            _Block(self.num_heads, dtype=self.dtype,
+                   num_experts=self.num_experts,
+                   capacity_factor=self.capacity_factor,
+                   name=f"block_{i}")
+            for i in range(self.num_layers)]
+        self.ln_f = nn.LayerNorm(dtype=jnp.float32, name="ln_f")
+        self.head = nn.Dense(self.vocab_size, name="head")
+
+    def embed(self, tokens):
+        """Token + positional embedding ([B, T] -> [B, T, D]). A method
+        (not inlined in ``__call__``) so pipeline parallelism's
+        replicated pre-stage applies THIS code via
+        ``module.apply(..., method='embed')`` and cannot drift."""
         dt = jnp.dtype(self.dtype)
-        t_len = tokens.shape[1]
-        x = nn.Embed(self.vocab_size, self.d_model, name="tok_embed")(
-            tokens).astype(dt)
-        pos = self.param("pos_embed", nn.initializers.normal(0.02),
-                         (self.max_len, self.d_model))
-        x = x + pos[:t_len].astype(dt)
-        for i in range(self.num_layers):
-            x = _Block(self.num_heads, dtype=self.dtype,
-                       num_experts=self.num_experts,
-                       name=f"block_{i}")(x, attn_override)
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
-        return nn.Dense(self.vocab_size, name="head")(x)
+        x = self.tok_embed(tokens).astype(dt)
+        return x + self.pos_embed[:tokens.shape[1]].astype(dt)
+
+    def head_apply(self, x):
+        """Final norm + LM head ([B, T, D] -> [B, T, vocab]); the
+        pipeline's replicated post-stage (see :meth:`embed`)."""
+        return self.head(self.ln_f(x))
+
+    def __call__(self, tokens, train: bool = False, attn_override=None):
+        x = self.embed(tokens)
+        for blk in self.blocks:
+            x = blk(x, attn_override)
+        return self.head_apply(x)
+
+
+def routing_fractions(module: TransformerLM, params, tokens):
+    """Per-layer expert routing fractions f_e for a batch — the
+    collapse-detection metric the Switch aux loss optimizes. Returns
+    ``{block_name: [E] array}`` (empty for dense models)."""
+    _, inter = module.apply({"params": params}, tokens,
+                            mutable=["intermediates"])
+    out = {}
+    flat = jax.tree_util.tree_flatten_with_path(
+        inter.get("intermediates", {}))[0]
+    for path, leaf in flat:
+        names = [getattr(p, "key", str(p)) for p in path]
+        if "expert_fraction" in names:
+            block = next((n for n in names if n.startswith("block_")),
+                         ".".join(names))
+            out[block] = leaf
+    return out
 
 
 def long_context_apply(module: TransformerLM, params, tokens, mesh,

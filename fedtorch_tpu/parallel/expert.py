@@ -3,53 +3,116 @@
 The reference has no MoE or expert parallelism (SURVEY.md §2.10: EP
 absent) — TPU-first new scope. The ``MoEMLP`` layer
 (models/transformer.py) keeps its expert weights on a leading ``[E]``
-axis; here that axis shards over an ``ep`` mesh axis: every device
-computes the dispatch -> expert-MLP -> combine core
-(``moe_expert_compute``, shared verbatim with the single-device module
-so the two cannot drift) for ITS experts only, and one ``psum`` merges
-the per-expert partial combines — each token's row is non-zero on
-exactly the device owning its routed expert, so the sum IS the routed
-output. Gating runs replicated (it is O(d·E) — tiny).
+axis; here that axis shards over an ``ep`` mesh axis. Two dispatch modes
+mirror the module's (transformer.py module docstring):
+
+* dense (``capacity_factor == 0``): every device runs the exact
+  dispatch -> expert-MLP -> combine core (``moe_expert_compute``, shared
+  verbatim with the single-device module so the two cannot drift) for
+  ITS experts only, and one ``psum`` merges the per-expert partial
+  combines — each token's row is non-zero on exactly the device owning
+  its routed expert, so the sum IS the routed output.
+* sparse (``capacity_factor > 0``): the shared Switch dispatch plan
+  (``moe_dispatch_plan``) is computed replicated (cheap — integer
+  cumsums over tokens); each device gathers only the tokens routed to
+  its expert shard into ``[E/n, C, D]``, runs the batched expert MLPs,
+  scatters its tokens' outputs, and one ``psum`` combines. FLOPs per
+  device = ``capacity_factor/n ×`` the dense MLP cost.
+
+Gating runs replicated (it is O(d·E) — tiny).
 """
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from fedtorch_tpu.models.transformer import moe_expert_compute
-
-# jitted expert-parallel layer per (mesh, axis, dtype) — signature-level
-# cache; shapes re-trace under the same jit entry as usual
-_EP_CACHE: dict = {}
+from fedtorch_tpu.models.transformer import (
+    moe_dispatch_plan, moe_expert_compute, moe_expert_mlp,
+)
 
 
-def ep_moe_apply(params, x, mesh: Mesh, axis_name: str = "ep"):
+def ep_moe_apply(params, x, mesh: Mesh, axis_name: str = "ep",
+                 capacity_factor: float = 0.0):
     """Run one MoEMLP layer with its experts sharded over ``axis_name``.
 
     ``params`` is the layer's param dict ({gate, w_in, b_in, w_out,
-    w_out, b_out}); ``x`` is [B, T, D]. Exact: equals
-    ``MoEMLP.apply`` to float tolerance."""
+    b_out}); ``x`` is [B, T, D]. ``capacity_factor`` selects the dispatch
+    mode exactly as on the module. Exact: equals ``MoEMLP.apply`` with
+    the same ``capacity_factor`` to float tolerance."""
     E = params["w_in"].shape[0]
     n = mesh.shape[axis_name]
     if E % n:
         raise ValueError(f"expert parallelism needs num_experts ({E}) "
                          f"divisible by the '{axis_name}' mesh axis "
                          f"({n})")
-    key = (mesh, axis_name, x.dtype, E)
-    if key not in _EP_CACHE:
-        espec = P(axis_name)
+    fwd = _ep_fwd(mesh, axis_name, jnp.dtype(x.dtype).name, E,
+                  float(capacity_factor))
+    return fwd(params, x)
 
-        def fwd(params, x):
-            logits = x.astype(jnp.float32) @ params["gate"]["kernel"]
-            probs = jax.nn.softmax(logits, axis=-1)
-            top_p = jnp.max(probs, axis=-1)
-            onehot = jax.nn.one_hot(jnp.argmax(probs, axis=-1), E,
-                                    dtype=x.dtype)
 
-            def local(w_in, b_in, w_out, b_out, oh, x_rep):
+@functools.lru_cache(maxsize=16)
+def _ep_fwd(mesh: Mesh, axis_name: str, dtype_name: str, E: int,
+            capacity_factor: float):
+    """Build + jit the expert-parallel layer for one (mesh, axis, dtype,
+    E, cf) signature. lru-bounded: meshes/executables from stale meshes
+    age out instead of accumulating for the process lifetime."""
+    dt = jnp.dtype(dtype_name)
+    espec = P(axis_name)
+    n = mesh.shape[axis_name]
+    e_local = E // n
+
+    def fwd(params, x):
+        logits = x.astype(jnp.float32) @ params["gate"]["kernel"]
+        probs = jax.nn.softmax(logits, axis=-1)
+        top_p = jnp.max(probs, axis=-1)
+        sel = jnp.argmax(probs, axis=-1)
+
+        if capacity_factor > 0:
+            B, T, D = x.shape
+            capacity = max(1, math.ceil(capacity_factor * B * T / E))
+            slot, keep, token_for_slot = moe_dispatch_plan(
+                sel, E, capacity)
+            xf_pad = jnp.concatenate(
+                [x.reshape(B * T, D), jnp.zeros((1, D), x.dtype)]
+            ).astype(dt)
+
+            def local(w_in, b_in, w_out, b_out, tfs, slot, keep,
+                      sel_flat, xf_pad):
+                # shard_map hands each device its [e_local, ...] weight
+                # shard: experts [idx*e_local, idx*e_local + e_local)
+                idx = jax.lax.axis_index(axis_name)
+                my_tfs = jax.lax.dynamic_slice(
+                    tfs, (idx * e_local * capacity,),
+                    (e_local * capacity,))
+                expert_in = xf_pad[my_tfs].reshape(
+                    e_local, capacity, -1)
+                y = moe_expert_mlp(expert_in, w_in, b_in, w_out, b_out)
+                y_pad = jnp.concatenate(
+                    [y.reshape(e_local * capacity, -1),
+                     jnp.zeros((1, y.shape[-1]), y.dtype)])
+                owned = (sel_flat // e_local) == idx
+                read = jnp.where(keep & owned,
+                                 slot - idx * e_local * capacity,
+                                 e_local * capacity)
+                return jax.lax.psum(y_pad[read], axis_name)
+
+            out = jax.shard_map(
+                local, mesh=mesh,
+                in_specs=(espec, espec, espec, espec,
+                          P(), P(), P(), P(), P()),
+                out_specs=P())(
+                params["w_in"].astype(dt), params["b_in"].astype(dt),
+                params["w_out"].astype(dt), params["b_out"].astype(dt),
+                token_for_slot, slot, keep, sel.reshape(-1), xf_pad)
+            out = out.reshape(x.shape)
+        else:
+            onehot = jax.nn.one_hot(sel, E, dtype=dt)
+
+            def local_dense(w_in, b_in, w_out, b_out, oh, x_rep):
                 # oh: [B, T, E/n] — this device's expert columns; the
                 # shared core then dispatches/combines only tokens
                 # routed here, zero rows elsewhere
@@ -58,15 +121,14 @@ def ep_moe_apply(params, x, mesh: Mesh, axis_name: str = "ep"):
                 return jax.lax.psum(y, axis_name)
 
             out = jax.shard_map(
-                local, mesh=mesh,
+                local_dense, mesh=mesh,
                 in_specs=(espec, espec, espec, espec,
                           P(None, None, axis_name), P()),
                 out_specs=P())(
-                params["w_in"].astype(x.dtype),
-                params["b_in"].astype(x.dtype),
-                params["w_out"].astype(x.dtype),
-                params["b_out"].astype(x.dtype), onehot, x)
-            return out * top_p[..., None].astype(x.dtype)
+                params["w_in"].astype(dt),
+                params["b_in"].astype(dt),
+                params["w_out"].astype(dt),
+                params["b_out"].astype(dt), onehot, x.astype(dt))
+        return out * top_p[..., None].astype(dt)
 
-        _EP_CACHE[key] = jax.jit(fwd)
-    return _EP_CACHE[key](params, x)
+    return jax.jit(fwd)

@@ -16,16 +16,11 @@ from __future__ import annotations
 import functools
 from typing import Optional
 
-import flax.linen as nn
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from fedtorch_tpu.models.transformer import TransformerLM, _Block
-
-# jitted pipelined forward per (module, mesh, axis, microbatches) — a
-# fresh shard_map trace per call would retrace every invocation
-_PIPE_CACHE: dict = {}
 
 
 def stack_block_params(params, num_layers: int):
@@ -106,36 +101,39 @@ def pipeline_apply(module: TransformerLM, params, tokens, mesh: Mesh,
         raise ValueError(f"batch ({B}) must divide into "
                          f"{M} microbatches")
 
-    key = (module, mesh, axis_name, M)
-    if key not in _PIPE_CACHE:
-        block_mod = _Block(module.num_heads, dtype=module.dtype,
-                           num_experts=module.num_experts)
-        local = functools.partial(
-            _pipeline_local, block_mod=block_mod, axis_name=axis_name,
-            num_stages=S, num_microbatches=M)
-        spec = P(axis_name)
+    return _pipelined_fwd(module, mesh, axis_name, M)(params, tokens)
 
-        def fwd(params, tokens):
-            dt = jnp.dtype(module.dtype)
-            # replicated pre/post stages apply the model's own
-            # submodules, so the pipelined forward cannot drift from
-            # TransformerLM.__call__ (transformer.py:83-92)
-            x = nn.Embed(module.vocab_size, module.d_model).apply(
-                {"params": params["tok_embed"]}, tokens).astype(dt)
-            x = x + params["pos_embed"][:tokens.shape[1]].astype(dt)
-            x_mbs = x.reshape(M, tokens.shape[0] // M, *x.shape[1:])
-            stacked = stack_block_params(params, L)
-            staged = jax.tree.map(
-                lambda a: a.reshape((S, L // S) + a.shape[1:]), stacked)
-            staged_specs = jax.tree.map(lambda _: spec, staged)
-            out = jax.shard_map(local, mesh=mesh,
-                                in_specs=(staged_specs, P()),
-                                out_specs=P())(staged, x_mbs)
-            x = out.reshape(*tokens.shape, -1)
-            x = nn.LayerNorm(dtype=jnp.float32).apply(
-                {"params": params["ln_f"]}, x)
-            return nn.Dense(module.vocab_size).apply(
-                {"params": params["head"]}, x)
 
-        _PIPE_CACHE[key] = jax.jit(fwd)
-    return _PIPE_CACHE[key](params, tokens)
+@functools.lru_cache(maxsize=16)
+def _pipelined_fwd(module: TransformerLM, mesh: Mesh, axis_name: str,
+                   M: int):
+    """Build + jit the pipelined forward for one (module, mesh, axis,
+    microbatches) signature. lru-bounded so executables (and the Mesh
+    objects their keys pin) age out of long-lived processes."""
+    S = mesh.shape[axis_name]
+    L = module.num_layers
+    block_mod = _Block(module.num_heads, dtype=module.dtype,
+                       num_experts=module.num_experts,
+                       capacity_factor=module.capacity_factor)
+    local = functools.partial(
+        _pipeline_local, block_mod=block_mod, axis_name=axis_name,
+        num_stages=S, num_microbatches=M)
+    spec = P(axis_name)
+
+    def fwd(params, tokens):
+        # replicated pre/post stages run the MODEL'S OWN embed /
+        # head_apply methods, so they are the same code
+        # TransformerLM.__call__ executes and cannot drift
+        x = module.apply({"params": params}, tokens, method="embed")
+        x_mbs = x.reshape(M, tokens.shape[0] // M, *x.shape[1:])
+        stacked = stack_block_params(params, L)
+        staged = jax.tree.map(
+            lambda a: a.reshape((S, L // S) + a.shape[1:]), stacked)
+        staged_specs = jax.tree.map(lambda _: spec, staged)
+        out = jax.shard_map(local, mesh=mesh,
+                            in_specs=(staged_specs, P()),
+                            out_specs=P())(staged, x_mbs)
+        x = out.reshape(*tokens.shape, -1)
+        return module.apply({"params": params}, x, method="head_apply")
+
+    return jax.jit(fwd)

@@ -15,14 +15,19 @@ the two address different memory walls.
 """
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-# jitted forward per module (flax modules are hashable) — a fresh jit
-# closure per tp_apply call would retrace every invocation
-_FWD_CACHE: dict = {}
+
+@functools.lru_cache(maxsize=16)
+def _jitted_fwd(module):
+    """One jitted forward per module (flax modules are hashable) — a
+    fresh jit closure per tp_apply call would retrace every invocation.
+    lru-bounded so executables age out of long-lived processes."""
+    return jax.jit(lambda p, t: module.apply({"params": p}, t))
 
 
 def transformer_tp_specs(params, axis_name: str = "tp",
@@ -78,7 +83,4 @@ def tp_apply(module, params, tokens, mesh: Mesh,
         params, specs)
     tok_spec = P(dp_axis) if dp_axis else P()
     toks = jax.device_put(tokens, NamedSharding(mesh, tok_spec))
-    if module not in _FWD_CACHE:
-        _FWD_CACHE[module] = jax.jit(
-            lambda p, t: module.apply({"params": p}, t))
-    return _FWD_CACHE[module](p_sharded, toks)
+    return _jitted_fwd(module)(p_sharded, toks)
