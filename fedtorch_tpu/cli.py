@@ -106,6 +106,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Switch load-balance aux-loss weight (0.01 in "
                         "the paper); 0 disables and the gate can "
                         "collapse onto one expert")
+    p.add_argument("--attention", default="dense",
+                   choices=("dense", "flash"),
+                   help="transformer attention backend: 'flash' = fused "
+                        "online-softmax pallas kernel on TPU (exact; "
+                        "dense fallback off-TPU)")
     # training scheme (parameters.py:118-141)
     p.add_argument("--stop_criteria", default="epoch")
     p.add_argument("--num_epochs", type=int, default=None)
@@ -229,7 +234,8 @@ def args_to_config(args) -> ExperimentConfig:
             vocab_size=args.vocab_size,
             moe_experts=args.moe_experts,
             moe_capacity_factor=args.moe_capacity_factor,
-            moe_aux_weight=args.moe_aux_weight),
+            moe_aux_weight=args.moe_aux_weight,
+            attention=args.attention),
         optim=OptimConfig(
             optimizer=args.optimizer, lr=args.lr,
             in_momentum=args.in_momentum,

@@ -135,6 +135,10 @@ class ModelConfig:
     # §2.2; paper default 0.01). 0 disables; without it the top-1 gate
     # can collapse onto one expert.
     moe_aux_weight: float = 0.0
+    # transformer attention backend: 'dense' (materialized scores) or
+    # 'flash' (fused online-softmax pallas kernel on TPU, O(block^2)
+    # score memory; exact, dense fallback off-TPU)
+    attention: str = "dense"
     pretrained: bool = False
     # 'robust_*' archs learn an adversarial input-noise parameter.
     robust_noise_ascent_lr: float = 0.1

@@ -125,7 +125,8 @@ def define_model(cfg: ExperimentConfig, batch_size: int = 2) -> ModelDef:
                                num_layers=m.mlp_num_layers,
                                dtype=cfg.mesh.compute_dtype,
                                num_experts=m.moe_experts,
-                               capacity_factor=m.moe_capacity_factor)
+                               capacity_factor=m.moe_capacity_factor,
+                               attention=m.attention)
         sample = jnp.zeros((batch_size, m.rnn_seq_len), jnp.int32)
         return ModelDef(arch, module, sample,
                         has_aux_loss=m.moe_experts > 0)

@@ -41,6 +41,7 @@ import jax.numpy as jnp
 class _SelfAttention(nn.Module):
     num_heads: int
     dtype: str = "float32"
+    attention: str = "dense"  # 'dense' | 'flash' (pallas kernel on TPU)
 
     @nn.compact
     def __call__(self, x, attn_override=None):
@@ -55,6 +56,13 @@ class _SelfAttention(nn.Module):
         if attn_override is not None:
             # sequence-parallel ring attention ([B, T, H, D] in/out)
             out = attn_override(q, k, v)
+        elif self.attention == "flash":
+            # fused online-softmax kernel: O(block^2) score memory, one
+            # HBM write (ops/pallas/flash_attention.py; exact, with a
+            # dense fallback off-TPU)
+            from fedtorch_tpu.ops.pallas.flash_attention import \
+                flash_attention
+            out = flash_attention(q, k, v, causal=True).astype(dt)
         else:
             scale = 1.0 / math.sqrt(head_dim)
             scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
@@ -198,12 +206,14 @@ class _Block(nn.Module):
     dtype: str = "float32"
     num_experts: int = 0  # 0 = dense MLP; >0 = MoE (Switch top-1)
     capacity_factor: float = 0.0
+    attention: str = "dense"
 
     @nn.compact
     def __call__(self, x, attn_override=None):
         dt = jnp.dtype(self.dtype)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(dt)
         x = x + _SelfAttention(self.num_heads, self.dtype,
+                               self.attention,
                                name="attn")(h, attn_override)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(dt)
         if self.num_experts > 0:
@@ -226,6 +236,7 @@ class TransformerLM(nn.Module):
     dtype: str = "float32"
     num_experts: int = 0  # >0 swaps every block's MLP for a Switch MoE
     capacity_factor: float = 0.0  # MoE dispatch mode (module docstring)
+    attention: str = "dense"  # 'dense' | 'flash'
 
     def setup(self):
         self.tok_embed = nn.Embed(self.vocab_size, self.d_model,
@@ -237,6 +248,7 @@ class TransformerLM(nn.Module):
             _Block(self.num_heads, dtype=self.dtype,
                    num_experts=self.num_experts,
                    capacity_factor=self.capacity_factor,
+                   attention=self.attention,
                    name=f"block_{i}")
             for i in range(self.num_layers)]
         self.ln_f = nn.LayerNorm(dtype=jnp.float32, name="ln_f")

@@ -114,7 +114,8 @@ def _pipelined_fwd(module: TransformerLM, mesh: Mesh, axis_name: str,
     L = module.num_layers
     block_mod = _Block(module.num_heads, dtype=module.dtype,
                        num_experts=module.num_experts,
-                       capacity_factor=module.capacity_factor)
+                       capacity_factor=module.capacity_factor,
+                       attention=module.attention)
     local = functools.partial(
         _pipeline_local, block_mod=block_mod, axis_name=axis_name,
         num_stages=S, num_microbatches=M)
