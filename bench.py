@@ -210,17 +210,34 @@ def main():
     trainer = FederatedTrainer(cfg, model, make_algorithm(cfg), data)
     server, clients = trainer.init_state(jax.random.key(0))
 
-    # warmup/compile
-    t0 = time.time()
-    server, clients, _ = trainer.run_round(server, clients)
-    jax.block_until_ready(server.params)
-    log(f"compile+first round: {time.time() - t0:.1f}s")
-
-    t0 = time.time()
-    for _ in range(TIMED_ROUNDS):
-        server, clients, metrics = trainer.run_round(server, clients)
-    jax.block_until_ready(server.params)
-    dt = time.time() - t0
+    # timed segment: all rounds in ONE device call (lax.scan over the
+    # round program — no per-round host dispatch); BENCH_SINGLE_DISPATCH=0
+    # reverts to the per-round loop for A/B. Each mode warms up (and
+    # compiles) only ITS OWN program — the other would be a wasted
+    # 40-50s XLA compile on the relay-attached chip.
+    batched = os.environ.get("BENCH_SINGLE_DISPATCH", "1") == "1"
+    if batched:
+        t0 = time.time()
+        server, clients, _ = trainer.run_rounds(server, clients,
+                                                TIMED_ROUNDS)
+        jax.block_until_ready(server.params)
+        log(f"compile+first batched {TIMED_ROUNDS}-round call: "
+            f"{time.time() - t0:.1f}s")
+        t0 = time.time()
+        server, clients, metrics = trainer.run_rounds(server, clients,
+                                                      TIMED_ROUNDS)
+        jax.block_until_ready(server.params)
+        dt = time.time() - t0
+    else:
+        t0 = time.time()
+        server, clients, _ = trainer.run_round(server, clients)
+        jax.block_until_ready(server.params)
+        log(f"compile+first round: {time.time() - t0:.1f}s")
+        t0 = time.time()
+        for _ in range(TIMED_ROUNDS):
+            server, clients, metrics = trainer.run_round(server, clients)
+        jax.block_until_ready(server.params)
+        dt = time.time() - t0
 
     n_chips = int(trainer.mesh.devices.size)
     steps = TIMED_ROUNDS * trainer.k_online * trainer.local_steps
@@ -246,7 +263,8 @@ def main():
 
     baseline, baseline_is_live = measure_torch_baseline()
     note = ("zero-egress container: CIFAR-shaped synthetic shards "
-            "(real CIFAR download gated)")
+            "(real CIFAR download gated); dispatch="
+            + ("batched-scan" if batched else "per-round"))
     if fallback_cpu:
         note += "; TPU RELAY WEDGED - CPU fallback, not a TPU number"
     elif baseline < TORCH_CPU_BEST_OBSERVED:

@@ -148,6 +148,7 @@ class FederatedTrainer:
             pad_client_axis(val_data, self.padded_clients), self.mesh) \
             if val_data is not None else None
         self._round_jit = jax.jit(self.round_fn, donate_argnums=(0, 1))
+        self._rounds_jit: dict = {}  # num_rounds -> jitted scan driver
 
     # -- state ----------------------------------------------------------
     def init_state(self, rng: jax.Array) -> Tuple[ServerState, ClientState]:
@@ -449,6 +450,32 @@ class FederatedTrainer:
     # -- host-side round loop ---------------------------------------------
     def run_round(self, server, clients):
         return self._round_jit(server, clients, self.data, self.val_data)
+
+    def run_rounds(self, server, clients, num_rounds: int):
+        """``num_rounds`` communication rounds in ONE device call: the
+        round program scanned with ``lax.scan``, so the host dispatches
+        once instead of once per round (no per-round Python/dispatch
+        gap on the device timeline — the bench path). Metrics come back
+        with a leading [num_rounds] axis. Trajectories equal
+        ``num_rounds`` calls of :meth:`run_round` to float tolerance
+        (same ops; the scan body is a separate XLA compilation, which
+        may reassociate float math). One jitted driver is cached per
+        distinct ``num_rounds``."""
+        if num_rounds not in self._rounds_jit:
+            def rounds_fn(server, clients, data, val_data):
+                def body(carry, _):
+                    s, c = carry
+                    s, c, m = self.round_fn(s, c, data, val_data)
+                    return (s, c), m
+
+                (s, c), ms = jax.lax.scan(
+                    body, (server, clients), None, length=num_rounds)
+                return s, c, ms
+
+            self._rounds_jit[num_rounds] = jax.jit(
+                rounds_fn, donate_argnums=(0, 1))
+        return self._rounds_jit[num_rounds](server, clients, self.data,
+                                            self.val_data)
 
     def fit(self, rng: jax.Array, num_rounds: Optional[int] = None,
             callback=None):

@@ -184,6 +184,43 @@ class TestDeterminism:
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+class TestBatchedRounds:
+    def test_run_rounds_matches_run_round(self):
+        """run_rounds (N rounds in one lax.scan device call, the bench
+        fast path) must reproduce N sequential run_round calls exactly:
+        same server params, same client state, same per-round metrics."""
+        trainer, _, _ = make_trainer(rate=0.5, local_step=3)
+        s1, c1 = trainer.init_state(jax.random.key(0))
+        s2, c2 = trainer.init_state(jax.random.key(0))
+        seq_metrics = []
+        for _ in range(3):
+            s1, c1, m = trainer.run_round(s1, c1)
+            seq_metrics.append(m)
+        s2, c2, ms = trainer.run_rounds(s2, c2, 3)
+        for a, b in zip(jax.tree.leaves(s1.params),
+                        jax.tree.leaves(s2.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-6)
+        for a, b in zip(jax.tree.leaves(c1), jax.tree.leaves(c2)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-6)
+        for r in range(3):
+            np.testing.assert_allclose(
+                np.asarray(ms.train_loss[r]),
+                np.asarray(seq_metrics[r].train_loss), atol=1e-6)
+            np.testing.assert_array_equal(
+                np.asarray(ms.online_mask[r]),
+                np.asarray(seq_metrics[r].online_mask))
+
+    def test_run_rounds_on_sharded_mesh(self):
+        """The scanned driver composes with the sharded client axis."""
+        trainer, _, _ = make_trainer(mesh_kw={"num_devices": 8})
+        s, c = trainer.init_state(jax.random.key(1))
+        s, c, ms = trainer.run_rounds(s, c, 2)
+        loss = np.asarray(ms.train_loss.sum(-1) / ms.online_mask.sum(-1))
+        assert loss.shape == (2,) and np.all(np.isfinite(loss))
+
+
 class TestScanUnroll:
     def test_unrolled_scan_matches_default(self):
         """mesh.scan_unroll is a compile-time pipelining knob; the local
