@@ -246,6 +246,11 @@ class MeshConfig:
     # results match the rolled scan to float tolerance (re-fusion of the
     # unrolled body may shift last-ulp rounding).
     scan_unroll: int = 1
+    # Per-block rematerialization (jax.checkpoint) for resnet/transformer
+    # archs: trade ~1.33x FLOPs for activation memory that scales with
+    # one block instead of the depth — the standard TPU HBM lever for
+    # deep models / long sequences. Same values, same gradients.
+    remat: bool = False
 
 
 @dataclass(frozen=True)

@@ -55,20 +55,32 @@ def define_model(cfg: ExperimentConfig, batch_size: int = 2) -> ModelDef:
     arch = cfg.model.arch
     dataset = cfg.data.dataset
     m = cfg.model
+    if cfg.mesh.remat and not (
+            arch.startswith(("resnet", "wideresnet", "densenet"))
+            or arch == "transformer"):
+        import warnings
+        warnings.warn(
+            f"--remat has no effect for arch {arch!r} (supported: "
+            "resnet*/wideresnet*/densenet*/transformer — the deep "
+            "activation-heavy families); running without "
+            "rematerialization", stacklevel=2)
     if arch.startswith("wideresnet"):
         module = build_wideresnet(arch, dataset, m.wideresnet_widen_factor,
                                   m.drop_rate, m.norm,
-                                  dtype=cfg.mesh.compute_dtype)
+                                  dtype=cfg.mesh.compute_dtype,
+                                  remat=cfg.mesh.remat)
         return ModelDef(arch, module, _sample_image(dataset, batch_size))
     if arch.startswith("resnet"):
         module = build_resnet(arch, dataset, m.norm,
-                              dtype=cfg.mesh.compute_dtype)
+                              dtype=cfg.mesh.compute_dtype,
+                              remat=cfg.mesh.remat)
         return ModelDef(arch, module, _sample_image(dataset, batch_size))
     if arch.startswith("densenet"):
         module = build_densenet(arch, dataset, m.densenet_growth_rate,
                                 m.densenet_bc_mode, m.densenet_compression,
                                 m.drop_rate, m.norm,
-                                dtype=cfg.mesh.compute_dtype)
+                                dtype=cfg.mesh.compute_dtype,
+                                remat=cfg.mesh.remat)
         return ModelDef(arch, module, _sample_image(dataset, batch_size))
     if arch == "logistic_regression":
         return ModelDef(arch, LogisticRegression(
@@ -126,7 +138,8 @@ def define_model(cfg: ExperimentConfig, batch_size: int = 2) -> ModelDef:
                                dtype=cfg.mesh.compute_dtype,
                                num_experts=m.moe_experts,
                                capacity_factor=m.moe_capacity_factor,
-                               attention=m.attention)
+                               attention=m.attention,
+                               remat=cfg.mesh.remat)
         sample = jnp.zeros((batch_size, m.rnn_seq_len), jnp.int32)
         return ModelDef(arch, module, sample,
                         has_aux_loss=m.moe_experts > 0)

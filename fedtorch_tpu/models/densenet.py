@@ -44,6 +44,7 @@ class DenseNet(nn.Module):
     drop_rate: float = 0.0
     norm: str = "bn"
     dtype: str = "float32"
+    remat: bool = False  # per-layer jax.checkpoint (see resnet.py)
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -52,14 +53,20 @@ class DenseNet(nn.Module):
         if self.bc_mode:
             layers_per_block //= 2
         ch = 2 * self.growth_rate if self.bc_mode else 16
+        # explicit names keep the param tree identical across the toggle
+        layer = nn.remat(_DenseLayer, static_argnums=(2,)) if self.remat \
+            else _DenseLayer
         x = nn.Conv(ch, (3, 3), padding=1, use_bias=False,
                     dtype=dt)(x.astype(dt))
+        li = 0
         for block in range(3):
             for _ in range(layers_per_block):
-                x = _DenseLayer(growth_rate=self.growth_rate,
-                                bc_mode=self.bc_mode,
-                                drop_rate=self.drop_rate, norm=self.norm,
-                                dtype=self.dtype)(x, train=train)
+                x = layer(growth_rate=self.growth_rate,
+                          bc_mode=self.bc_mode,
+                          drop_rate=self.drop_rate, norm=self.norm,
+                          dtype=self.dtype,
+                          name=f"_DenseLayer_{li}")(x, train)
+                li += 1
             if block < 2:
                 out_ch = int(x.shape[-1] * self.compression)
                 x = nn.relu(norm_f32(self.norm, x, dt))
@@ -72,10 +79,12 @@ class DenseNet(nn.Module):
 
 def build_densenet(arch: str, dataset: str, growth_rate: int, bc_mode: bool,
                    compression: float, drop_rate: float,
-                   norm: str = "bn", dtype: str = "float32") -> nn.Module:
+                   norm: str = "bn", dtype: str = "float32",
+                   remat: bool = False) -> nn.Module:
     """arch string 'densenet<depth>' (factory densenet.py:200-208)."""
     depth = int(arch.replace("densenet", ""))
     return DenseNet(dataset=dataset, depth=depth, growth_rate=growth_rate,
                     bc_mode=bc_mode,
                     compression=compression if bc_mode else 1.0,
-                    drop_rate=drop_rate, norm=norm, dtype=dtype)
+                    drop_rate=drop_rate, norm=norm, dtype=dtype,
+                    remat=remat)

@@ -83,6 +83,12 @@ class ResNetCifar(nn.Module):
     size: int
     norm: str = "bn"
     dtype: str = "float32"
+    # per-residual-block rematerialization (jax.checkpoint): backward
+    # recomputes each block's activations instead of storing them —
+    # ~1.33x the FLOPs for activation memory that scales with ONE block
+    # instead of the depth. The HBM<->FLOPs trade SURVEY.md's TPU notes
+    # call for; gradients are bitwise the same computation graph values.
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -91,15 +97,23 @@ class ResNetCifar(nn.Module):
         dt = jnp.dtype(self.dtype)
         x = x.astype(dt)
         n_blocks = (self.size - 2) // 6
-        block: Type = Bottleneck if self.size >= 44 else BasicBlock
+        base: Type = Bottleneck if self.size >= 44 else BasicBlock
+        # explicit names matching the plain auto-names so the param tree
+        # is IDENTICAL with remat on or off (checkpoints stay loadable
+        # across the toggle; remat wrappers auto-name differently)
+        block = nn.remat(base, static_argnums=(2,)) if self.remat \
+            else base  # train (arg 2, counting self) is static
         x = nn.Conv(16, (3, 3), padding=1, use_bias=False, dtype=dt)(x)
         x = _norm32(self.norm, x, dt)
         x = nn.relu(x)
+        bi = 0
         for stage, planes in enumerate((16, 32, 64)):
             for i in range(n_blocks):
                 stride = 2 if (stage > 0 and i == 0) else 1
                 x = block(planes=planes, stride=stride, norm=self.norm,
-                          dtype=self.dtype)(x, train=train)
+                          dtype=self.dtype,
+                          name=f"{base.__name__}_{bi}")(x, train)
+                bi += 1
         x = x.mean(axis=(1, 2))
         # classifier head in f32 for logit fidelity
         return nn.Dense(num_classes_of(self.dataset))(
@@ -111,6 +125,7 @@ class ResNetImageNet(nn.Module):
     size: int
     norm: str = "bn"
     dtype: str = "float32"
+    remat: bool = False  # see ResNetCifar.remat
 
     _PARAMS = {
         18: (BasicBlock, (2, 2, 2, 2)),
@@ -124,33 +139,39 @@ class ResNetImageNet(nn.Module):
     def __call__(self, x, train: bool = False):
         dt = jnp.dtype(self.dtype)
         x = x.astype(dt)
-        block, layers = self._PARAMS[self.size]
+        base, layers = self._PARAMS[self.size]
+        # explicit names: identical param tree with remat on/off (above)
+        block = nn.remat(base, static_argnums=(2,)) if self.remat \
+            else base
         x = nn.Conv(64, (7, 7), strides=(2, 2), padding=3, use_bias=False,
                     dtype=dt)(x)
         x = _norm32(self.norm, x, dt)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        bi = 0
         for stage, (planes, n_blocks) in enumerate(
                 zip((64, 128, 256, 512), layers)):
             for i in range(n_blocks):
                 stride = 2 if (stage > 0 and i == 0) else 1
                 x = block(planes=planes, stride=stride, norm=self.norm,
-                          dtype=self.dtype)(x, train=train)
+                          dtype=self.dtype,
+                          name=f"{base.__name__}_{bi}")(x, train)
+                bi += 1
         x = x.mean(axis=(1, 2))
         return nn.Dense(num_classes_of(self.dataset))(
             x.astype(jnp.float32))
 
 
 def build_resnet(arch: str, dataset: str, norm: str = "bn",
-                 dtype: str = "float32") -> nn.Module:
+                 dtype: str = "float32", remat: bool = False) -> nn.Module:
     """Factory matching resnet.py:260-274 arch-string parsing."""
     size = int(arch.replace("resnet", ""))
     if "cifar" in dataset or "svhn" in dataset \
             or "downsampled_imagenet" in dataset or dataset == "stl10":
         return ResNetCifar(dataset=dataset, size=size, norm=norm,
-                           dtype=dtype)
+                           dtype=dtype, remat=remat)
     if "imagenet" in dataset:
         return ResNetImageNet(dataset=dataset, size=size, norm=norm,
-                              dtype=dtype)
+                              dtype=dtype, remat=remat)
     raise NotImplementedError(
         f"resnet supports cifar/imagenet-family datasets, got {dataset!r}")

@@ -50,6 +50,7 @@ class WideResNet(nn.Module):
     drop_rate: float = 0.0
     norm: str = "bn"
     dtype: str = "float32"
+    remat: bool = False  # per-block jax.checkpoint (see resnet.py)
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -58,14 +59,20 @@ class WideResNet(nn.Module):
         dt = jnp.dtype(self.dtype)
         n = (self.depth - 4) // 6
         k = self.widen_factor
+        # explicit names keep the param tree identical across the toggle
+        block = nn.remat(_WideBasic, static_argnums=(2,)) if self.remat \
+            else _WideBasic
         x = nn.Conv(16, (3, 3), padding=1, use_bias=False,
                     dtype=dt)(x.astype(dt))
+        bi = 0
         for stage, planes in enumerate((16 * k, 32 * k, 64 * k)):
             for i in range(n):
                 stride = 2 if (stage > 0 and i == 0) else 1
-                x = _WideBasic(planes=planes, stride=stride,
-                               drop_rate=self.drop_rate, norm=self.norm,
-                               dtype=self.dtype)(x, train=train)
+                x = block(planes=planes, stride=stride,
+                          drop_rate=self.drop_rate, norm=self.norm,
+                          dtype=self.dtype,
+                          name=f"_WideBasic_{bi}")(x, train)
+                bi += 1
         x = nn.relu(make_norm(self.norm)(x.astype(jnp.float32)))
         x = x.mean(axis=(1, 2))
         return nn.Dense(num_classes_of(self.dataset))(x)
@@ -73,9 +80,10 @@ class WideResNet(nn.Module):
 
 def build_wideresnet(arch: str, dataset: str, widen_factor: int,
                      drop_rate: float, norm: str = "bn",
-                     dtype: str = "float32") -> nn.Module:
+                     dtype: str = "float32",
+                     remat: bool = False) -> nn.Module:
     """arch string 'wideresnet<depth>' (factory wideresnet.py:135-144)."""
     depth = int(arch.replace("wideresnet", ""))
     return WideResNet(dataset=dataset, depth=depth,
                       widen_factor=widen_factor, drop_rate=drop_rate,
-                      norm=norm, dtype=dtype)
+                      norm=norm, dtype=dtype, remat=remat)
