@@ -53,6 +53,8 @@ class DataConfig:
     synthetic_beta: float = 0.0
     synthetic_dim: int = 60
     synthetic_num_classes: int = 10
+    # lower edge of the per-client lognormal size window (upper = 2x);
+    # the default reproduces the reference's 500/1000 generator window
     synthetic_samples_per_client: int = 500
     synthetic_regression: bool = False
     # Adult sensitive-feature split (ref: parameters.py:37).

@@ -413,10 +413,16 @@ def get_dataset(cfg: DataConfig, num_clients: int,
     """Dispatch on dataset name (prepare_data.py:124-163)."""
     name, root = cfg.dataset, cfg.data_dir
     if name == "synthetic":
+        # synthetic_samples_per_client scales the reference's 500/1000
+        # lognormal size window (federated_datasets.py:253 defaults)
+        # proportionally: min = the knob, max = 2x — the default 500
+        # reproduces the reference exactly
+        spc = cfg.synthetic_samples_per_client
         data = generate_synthetic(
             num_tasks=num_clients, alpha=cfg.synthetic_alpha,
             beta=cfg.synthetic_beta, num_dim=cfg.synthetic_dim,
-            regression=cfg.synthetic_regression)
+            regression=cfg.synthetic_regression,
+            min_num_samples=spc, max_num_samples=2 * spc)
         sizes = [len(y) for y in data.client_y]
         offsets = np.concatenate([[0], np.cumsum(sizes)])
         parts = [np.arange(offsets[i], offsets[i + 1])

@@ -54,7 +54,7 @@ def _model_cases():
     import numpy as np
 
     def run(arch, feats, labels, *, dataset, dtype="float32", C=4, B=4,
-            model_kw=None, seq=None):
+            model_kw=None, mesh_kw=None, seq=None):
         parts = [np.arange(i * len(feats) // C, (i + 1) * len(feats) // C)
                  for i in range(C)]
         data = stack_partitions(feats, labels, parts)
@@ -70,7 +70,8 @@ def _model_cases():
             model=ModelConfig(arch=arch, **mkw),
             optim=OptimConfig(lr=0.05, in_momentum=True),
             train=TrainConfig(local_step=2),
-            mesh=MeshConfig(num_devices=1, compute_dtype=dtype),
+            mesh=MeshConfig(num_devices=1, compute_dtype=dtype,
+                            **(mesh_kw or {})),
         ).finalize()
         model = define_model(cfg, batch_size=B)
         trainer = FederatedTrainer(cfg, model, make_algorithm(cfg), data)
@@ -131,9 +132,57 @@ def _model_cases():
 
         return _run_sequence_parallel(1, label="tpu_zoo(1)")
 
+    def transformer_flash_moe():
+        # flash-attention kernel + sparse-MoE dispatch + Switch aux loss
+        # through the engine on the real chip, bf16
+        x = rng.randint(0, 86, (64, 64)).astype(np.int32)
+        y = np.roll(x, -1, axis=1).astype(np.int32)
+        return run("transformer", x, y, dataset="shakespeare",
+                   dtype="bfloat16", seq=64,
+                   model_kw={"mlp_num_layers": 2, "rnn_hidden_size": 32,
+                             "attention": "flash", "moe_experts": 4,
+                             "moe_capacity_factor": 1.25,
+                             "moe_aux_weight": 0.01})
+
+    def resnet_remat_bf16():
+        # per-block rematerialization through the real backward pass
+        return run("resnet20",
+                   rng.randn(64, 32, 32, 3).astype(np.float32),
+                   rng.randint(0, 10, 64), dataset="cifar10",
+                   dtype="bfloat16", mesh_kw={"remat": True})
+
+    def batched_rounds():
+        # the single-dispatch scan driver (bench fast path) on the chip
+        parts = [np.arange(i * 16, (i + 1) * 16) for i in range(4)]
+        feats = rng.randn(64, 20).astype(np.float32)
+        labels = rng.randint(0, 10, 64)
+        data = stack_partitions(feats, labels, parts)
+        cfg = ExperimentConfig(
+            data=DataConfig(dataset="synthetic", synthetic_dim=20,
+                            batch_size=8),
+            federated=FederatedConfig(federated=True, num_clients=4,
+                                      online_client_rate=1.0,
+                                      algorithm="fedavg",
+                                      sync_type="local_step"),
+            model=ModelConfig(arch="logistic_regression"),
+            optim=OptimConfig(lr=0.05),
+            train=TrainConfig(local_step=2),
+            mesh=MeshConfig(num_devices=1),
+        ).finalize()
+        model = define_model(cfg, batch_size=8)
+        trainer = FederatedTrainer(cfg, model, make_algorithm(cfg), data)
+        server, clients = trainer.init_state(jax.random.key(0))
+        server, clients, ms = trainer.run_rounds(server, clients, 3)
+        jax.block_until_ready(server.params)
+        return float(ms.train_loss[-1].sum()
+                     / max(float(ms.online_mask[-1].sum()), 1.0))
+
     return [("resnet20_bf16", resnet_bf16, "loss"),
             ("rnn_gru_bf16", gru_shakespeare, "loss"),
             ("transformer_bf16", transformer_lm, "loss"),
+            ("transformer_flash_moe_bf16", transformer_flash_moe, "loss"),
+            ("resnet20_remat_bf16", resnet_remat_bf16, "loss"),
+            ("batched_rounds_scan", batched_rounds, "loss"),
             ("local_sgd_cnn_bf16", local_sgd, "loss"),
             ("seqpar_1chip", seqpar_single_chip, "err")]
 
