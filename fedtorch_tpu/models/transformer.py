@@ -301,21 +301,32 @@ def routing_fractions(module: TransformerLM, params, tokens):
 
 
 def long_context_apply(module: TransformerLM, params, tokens, mesh,
-                       axis_name: str = "sp", strategy: str = "ring"):
+                       axis_name: str = "sp", strategy: str = "ring",
+                       block_impl: str = "dense"):
     """Forward with every attention block running exact sequence-parallel
     attention, the sequence axis sharded over ``mesh``'s ``axis_name``.
 
     ``strategy``: 'ring' (K/V rotation, any head count) or 'ulysses'
     (head-parallel all-to-all; needs heads % mesh size == 0) — see
-    parallel/sequence.py for the memory/ICI trade."""
+    parallel/sequence.py for the memory/ICI trade. ``block_impl='flash'``
+    (ring only) attends each rotating block through the fused flash
+    kernel — the Ring Attention paper's blockwise-kernel form."""
     from fedtorch_tpu.parallel.sequence import ring_attention, \
         ulysses_attention
 
     if strategy not in ("ring", "ulysses"):
         raise ValueError(f"unknown sequence-parallel strategy {strategy!r}")
-    attn_fn = ring_attention if strategy == "ring" else ulysses_attention
+    if strategy == "ulysses" and block_impl != "dense":
+        raise ValueError(
+            "block_impl applies to the ring strategy only (ulysses "
+            "attends the full sequence per head slice); got "
+            f"block_impl={block_impl!r} with strategy='ulysses'")
 
     def attn(q, k, v):
-        return attn_fn(q, k, v, mesh, axis_name=axis_name, causal=True)
+        if strategy == "ring":
+            return ring_attention(q, k, v, mesh, axis_name=axis_name,
+                                  causal=True, block_impl=block_impl)
+        return ulysses_attention(q, k, v, mesh, axis_name=axis_name,
+                                 causal=True)
 
     return module.apply({"params": params}, tokens, attn_override=attn)

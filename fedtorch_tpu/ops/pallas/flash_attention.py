@@ -155,16 +155,23 @@ def _fwd_xla(q3, k3, v3, scale: float, causal: bool):
     return o.astype(q3.dtype), lse
 
 
-def _bwd_chunked(res, g, *, scale: float, causal: bool, block_q: int):
+def _bwd_chunked(res, g, g_lse=None, *, scale: float, causal: bool,
+                 block_q: int):
     """Flash VJP: recompute p blockwise from the saved logsumexp and
     accumulate dk/dv over a q-block scan — O(T·block_q) live memory.
     Pure XLA on purpose: it runs identically on TPU and in CPU tests,
-    and XLA fuses the per-block einsums well."""
+    and XLA fuses the per-block einsums well.
+
+    ``g_lse`` is the logsumexp cotangent (when the caller consumed the
+    lse output — the ring-attention merge does): ∂lse/∂s = p, so it
+    adds a ``g_lse·p`` term to the score cotangent; lse is independent
+    of v."""
     q3, k3, v3, o3, lse = res
     BH, T, D = q3.shape
     f32 = jnp.float32
     q3f, k3f, v3f, o3f, gf = (t.astype(f32) for t in
                               (q3, k3, v3, o3, g))
+    glf = jnp.zeros_like(lse) if g_lse is None else g_lse.astype(f32)
     # D_i = rowsum(do * o) — the softmax-jacobian diagonal term
     delta = jnp.sum(gf * o3f, axis=-1)                   # [BH, T]
     nq = T // block_q
@@ -176,6 +183,7 @@ def _bwd_chunked(res, g, *, scale: float, causal: bool, block_q: int):
         g_i = sl(gf, i * block_q, block_q, 1)
         lse_i = sl(lse, i * block_q, block_q, 1)
         d_i = sl(delta, i * block_q, block_q, 1)
+        gl_i = sl(glf, i * block_q, block_q, 1)
         s = jnp.einsum("bqd,bkd->bqk", q_i, k3f) * scale
         if causal:
             q_pos = i * block_q + jnp.arange(block_q)
@@ -185,7 +193,7 @@ def _bwd_chunked(res, g, *, scale: float, causal: bool, block_q: int):
         p = jnp.where(jnp.isfinite(s), p, 0.0)           # [BH, bq, T]
         dv = dv + jnp.einsum("bqk,bqd->bkd", p, g_i)
         dp = jnp.einsum("bqd,bkd->bqk", g_i, v3f)
-        ds = p * (dp - d_i[..., None]) * scale
+        ds = p * (dp - d_i[..., None] + gl_i[..., None]) * scale
         dq_i = jnp.einsum("bqk,bkd->bqd", ds, k3f)
         dk = dk + jnp.einsum("bqk,bqd->bkd", ds, q_i)
         return (dk, dv), dq_i
@@ -223,6 +231,38 @@ def _flash3_bwd(scale, causal, block_q, block_k, use_pallas, res, g):
 _flash3.defvjp(_flash3_fwd, _flash3_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash3_lse(q3, k3, v3, scale, causal, block_q, block_k,
+                use_pallas):
+    """Like _flash3 but also returns the logsumexp [BH, T] — the
+    statistic that makes attention outputs MERGEABLE (ring attention
+    combines per-block results by lse weighting). Differentiable in
+    both outputs (joint VJP in _bwd_chunked)."""
+    out, res = _flash3_lse_fwd(q3, k3, v3, scale, causal, block_q,
+                               block_k, use_pallas)
+    return out
+
+
+def _flash3_lse_fwd(q3, k3, v3, scale, causal, block_q, block_k,
+                    use_pallas):
+    # one backend-dispatch implementation: _flash3_fwd's residuals
+    # already carry the lse, so the lse-returning variant just
+    # surfaces it — the two public kernels cannot diverge
+    out, res = _flash3_fwd(q3, k3, v3, scale, causal, block_q, block_k,
+                           use_pallas)
+    return (out, res[4]), res
+
+
+def _flash3_lse_bwd(scale, causal, block_q, block_k, use_pallas, res,
+                    g):
+    g_o, g_lse = g
+    return _bwd_chunked(res, g_o, g_lse, scale=scale, causal=causal,
+                        block_q=block_q)
+
+
+_flash3_lse.defvjp(_flash3_lse_fwd, _flash3_lse_bwd)
+
+
 def on_tpu() -> bool:
     try:
         return jax.devices()[0].platform == "tpu"
@@ -246,18 +286,9 @@ def _divisor_block(T: int, block: int) -> int:
     return d if d >= 16 else T
 
 
-def flash_attention(q, k, v, causal: bool = False,
-                    scale: Optional[float] = None, block_q: int = 128,
-                    block_k: int = 128,
-                    force: Optional[str] = None) -> jnp.ndarray:
-    """Exact attention, [B, T, H, D] in/out, differentiable.
-
-    Backend selection: the Pallas kernel on TPU; its interpreter when
-    ``force='interpret'`` (CPU kernel tests); the dense-oracle math
-    otherwise (CPU training/eval — same semantics, standard memory).
-    Requested block sizes are adjusted to divisors of T (static shapes:
-    decided once at trace time), so both the kernel grid and the
-    chunked VJP always tile the sequence exactly."""
+def _prep(q, k, v, scale, block_q, block_k, force):
+    """Shared wrapper plumbing: [B,T,H,D] -> [BH,T,D] layout, divisor
+    block sizes, backend selection."""
     B, T, H, D = q.shape
     if scale is None:
         scale = 1.0 / math.sqrt(D)
@@ -271,6 +302,40 @@ def flash_attention(q, k, v, causal: bool = False,
         use_pallas = False
     else:
         use_pallas = True
-    out3 = _flash3(q3, k3, v3, scale, causal, block_q, block_k,
-                   use_pallas)
+    return (q3, k3, v3), (B, T, H, D), scale, block_q, block_k, \
+        use_pallas
+
+
+def flash_attention(q, k, v, causal: bool = False,
+                    scale: Optional[float] = None, block_q: int = 128,
+                    block_k: int = 128,
+                    force: Optional[str] = None) -> jnp.ndarray:
+    """Exact attention, [B, T, H, D] in/out, differentiable.
+
+    Backend selection: the Pallas kernel on TPU; its interpreter when
+    ``force='interpret'`` (CPU kernel tests); the dense-oracle math
+    otherwise (CPU training/eval — same semantics, standard memory).
+    Requested block sizes are adjusted to divisors of T (static shapes:
+    decided once at trace time), so both the kernel grid and the
+    chunked VJP always tile the sequence exactly."""
+    (q3, k3, v3), (B, T, H, D), scale, bq, bk, use_pallas = _prep(
+        q, k, v, scale, block_q, block_k, force)
+    out3 = _flash3(q3, k3, v3, scale, causal, bq, bk, use_pallas)
     return out3.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+
+
+def flash_attention_with_lse(q, k, v, causal: bool = False,
+                             scale: Optional[float] = None,
+                             block_q: int = 128, block_k: int = 128,
+                             force: Optional[str] = None):
+    """:func:`flash_attention` that also returns the logsumexp
+    ([B, T, H] f32) — the merge statistic for combining attention over
+    disjoint K/V blocks: pieces (o_i, lse_i) over K-partitions combine
+    exactly via lse-weighted averaging (ring attention's per-step
+    blocks, parallel/sequence.py). Differentiable in both outputs."""
+    (q3, k3, v3), (B, T, H, D), scale, bq, bk, use_pallas = _prep(
+        q, k, v, scale, block_q, block_k, force)
+    o3, lse3 = _flash3_lse(q3, k3, v3, scale, causal, bq, bk,
+                           use_pallas)
+    o = o3.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+    return o, lse3.reshape(B, H, T).transpose(0, 2, 1)

@@ -66,6 +66,86 @@ def _block_attend(q, k, v, m_prev, l_prev, o_prev, q_offset, k_offset,
     return m_new, l_new, o_new
 
 
+def _merge_lse(o1, lse1, o2, lse2):
+    """Exact merge of two attention pieces over DISJOINT key sets.
+
+    Each piece is (normalized output [B, T, H, D], logsumexp [B, T, H]);
+    the unnormalized sum of piece i is ``exp(lse_i)·o_i``, so the
+    combined attention is the lse-weighted average. A fully-masked piece
+    carries lse = -inf and weighs 0."""
+    m = jnp.maximum(lse1, lse2)
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    w1 = jnp.exp(lse1 - m_safe)
+    w2 = jnp.exp(lse2 - m_safe)
+    denom = jnp.maximum(w1 + w2, 1e-30)
+    o = (o1 * w1[..., None].astype(o1.dtype)
+         + o2 * w2[..., None].astype(o2.dtype)) \
+        / denom[..., None].astype(o1.dtype)
+    lse = jnp.where(w1 + w2 > 0, m_safe + jnp.log(denom), -jnp.inf)
+    return o, lse
+
+
+def _ring_flash_local(q, k, v, *, axis_name: str, causal: bool,
+                      scale: float):
+    """Ring body with the FLASH block kernel: each ring step attends the
+    local Q block to the rotating K/V block through
+    ``flash_attention_with_lse`` (O(block²) score tiles — the Ring
+    Attention paper's blockwise-kernel formulation, arXiv:2310.01889),
+    and the per-step pieces merge by logsumexp weighting (exact).
+
+    Causality is resolved at BLOCK granularity: a K block strictly
+    before the local Q block attends densely, the diagonal block runs
+    the causal kernel, and blocks strictly after contribute an -inf-lse
+    piece without computing anything."""
+    from fedtorch_tpu.ops.pallas.flash_attention import \
+        flash_attention_with_lse
+
+    num_shards = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+
+    def attend_block(k_blk, v_blk, src, o_run, lse_run):
+        def full(_):
+            return flash_attention_with_lse(q, k_blk, v_blk,
+                                            causal=False, scale=scale)
+
+        def diag(_):
+            return flash_attention_with_lse(q, k_blk, v_blk,
+                                            causal=True, scale=scale)
+
+        def skip(_):
+            return (jnp.zeros_like(q),
+                    (q[..., 0] * 0.0).astype(jnp.float32) - jnp.inf)
+
+        if causal:
+            mode = jnp.where(src < my_idx, 0,
+                             jnp.where(src == my_idx, 1, 2))
+            o_b, lse_b = jax.lax.switch(mode, (full, diag, skip), None)
+        else:
+            o_b, lse_b = full(None)
+        return _merge_lse(o_run, lse_run, o_b, lse_b)
+
+    # initial (o, lse) derive from q so they carry the varying-axis type
+    o0 = jnp.zeros_like(q)
+    lse0 = (q[..., 0] * 0.0).astype(jnp.float32) - jnp.inf
+
+    def step(carry, s):
+        k_blk, v_blk, o_run, lse_run = carry
+        src = (my_idx - s) % num_shards
+        o_run, lse_run = attend_block(k_blk, v_blk, src, o_run, lse_run)
+        perm = [(i, (i + 1) % num_shards) for i in range(num_shards)]
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (k_blk, v_blk, o_run, lse_run), None
+
+    # scan the first S-1 blocks, attend the final received block outside
+    # the scan — saving one discarded ICI rotation (as the dense body)
+    (k_last, v_last, o, lse), _ = jax.lax.scan(
+        step, (k, v, o0, lse0), jnp.arange(num_shards - 1))
+    src_last = (my_idx - (num_shards - 1)) % num_shards
+    o, _ = attend_block(k_last, v_last, src_last, o, lse)
+    return o.astype(q.dtype)
+
+
 def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
                           scale: float):
     """Per-shard body (inside shard_map): rotate K/V around the ring."""
@@ -125,13 +205,24 @@ def _seq_sharded_call(local_fn, q, k, v, mesh: Mesh, axis_name: str,
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    mesh: Mesh, axis_name: str = "sp",
                    causal: bool = False,
-                   scale: Optional[float] = None) -> jnp.ndarray:
+                   scale: Optional[float] = None,
+                   block_impl: str = "dense") -> jnp.ndarray:
     """Exact attention with the sequence axis sharded over ``axis_name``.
 
     Inputs/outputs [batch, seq, heads, head_dim]; seq must divide evenly
-    over the mesh axis."""
-    return _seq_sharded_call(_ring_attention_local, q, k, v, mesh,
-                             axis_name, causal, scale)
+    over the mesh axis.
+
+    ``block_impl``: how each ring step attends its K/V block —
+    'dense' materializes the [T/n, T/n] block scores (the online-softmax
+    body above); 'flash' runs the fused flash kernel per block
+    (O(block²) score tiles on TPU, exact lse-weighted merge) and skips
+    causally-dead blocks without computing them."""
+    if block_impl not in ("dense", "flash"):
+        raise ValueError(f"unknown ring block_impl {block_impl!r}")
+    local = _ring_flash_local if block_impl == "flash" \
+        else _ring_attention_local
+    return _seq_sharded_call(local, q, k, v, mesh, axis_name, causal,
+                             scale)
 
 
 def _ulysses_local(q, k, v, *, axis_name: str, causal: bool,
