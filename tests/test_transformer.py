@@ -76,6 +76,46 @@ def test_federated_training_converges():
     assert loss < first * 0.5, (first, loss)
 
 
+@pytest.mark.parametrize("algorithm,fed_kw", [
+    ("scaffold", {}),
+    ("fedgate", {"compressed": True, "compressed_ratio": 0.5}),
+    ("qsparse", {"compressed": True, "compressed_ratio": 0.5}),
+    ("apfl", {"personal": True}),
+])
+def test_algorithm_zoo_composes_with_transformer(algorithm, fed_kw):
+    """The aggregation families are pytree-generic: control variates,
+    top-k wire formats, and personal models must run unchanged on the
+    transformer (incl. a sparse-MoE variant), not just the MLP the
+    dryrun matrix uses. One round each, finite loss."""
+    from fedtorch_tpu.algorithms import make_algorithm
+    from fedtorch_tpu.data.batching import stack_partitions
+    from fedtorch_tpu.parallel import FederatedTrainer
+
+    rng = np.random.RandomState(1)
+    x = rng.randint(0, 86, (32, 16)).astype(np.int32)
+    y = np.roll(x, -1, axis=1).astype(np.int32)
+    parts = [np.arange(i * 8, (i + 1) * 8) for i in range(4)]
+    data = stack_partitions(x, y, parts)
+    cfg = ExperimentConfig(
+        data=DataConfig(dataset="shakespeare", batch_size=4),
+        federated=FederatedConfig(federated=True, num_clients=4,
+                                  online_client_rate=1.0,
+                                  algorithm=algorithm,
+                                  sync_type="local_step", **fed_kw),
+        model=ModelConfig(arch="transformer", rnn_seq_len=16,
+                          rnn_hidden_size=8, mlp_num_layers=1,
+                          moe_experts=2, moe_capacity_factor=1.5),
+        optim=OptimConfig(lr=0.05, weight_decay=0.0),
+        train=TrainConfig(local_step=2),
+    ).finalize()
+    model = define_model(cfg, batch_size=4)
+    trainer = FederatedTrainer(cfg, model, make_algorithm(cfg), data)
+    server, clients = trainer.init_state(jax.random.key(0))
+    _, _, m = trainer.run_round(server, clients)
+    loss = float(m.train_loss.sum() / m.online_mask.sum())
+    assert np.isfinite(loss)
+
+
 def test_long_context_ring_matches_dense():
     """The ring-attention forward must equal the dense forward."""
     model = _model(seq_len=64)
