@@ -16,6 +16,7 @@ Prints exactly ONE JSON line on stdout; diagnostics go to stderr.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -24,8 +25,10 @@ import time
 # reports this most recent LIVE capture — with full disclosure in the
 # notes — instead of a meaningless CPU-fallback rate. Rationale: the
 # metric is "local-steps/sec/chip on the TPU"; a CPU number measures the
-# relay's mood, not the framework.
-TPU_CAPTURE_PATH = "TPU_BENCH_CAPTURE.json"
+# relay's mood, not the framework. Anchored to the repo (like _git), not
+# the cwd, so write and read always meet.
+TPU_CAPTURE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "TPU_BENCH_CAPTURE.json")
 
 # Measured on this container (1 CPU core): reference resnet20, batch 50,
 # plain SGD step loop -> 5.76 steps/s (see docstring; remeasured live when
@@ -35,8 +38,6 @@ TORCH_CPU_FALLBACK_STEPS_PER_SEC = 5.76
 # unloaded). The live measurement is floored here so concurrent CPU load
 # at bench time cannot deflate the baseline and overstate vs_baseline.
 TORCH_CPU_BEST_OBSERVED = 18.20
-
-import os
 
 SMOKE = os.environ.get("BENCH_SMOKE") == "1"  # tiny CPU smoke-test sizes
 NUM_CLIENTS = 8 if SMOKE else 100

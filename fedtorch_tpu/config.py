@@ -52,7 +52,11 @@ class DataConfig:
     synthetic_alpha: float = 0.0
     synthetic_beta: float = 0.0
     synthetic_dim: int = 60
-    synthetic_num_classes: int = 10
+    # default matches the reference GENERATOR (federated_datasets.py:205
+    # num_classes=2). Note the reference's own quirk, reproduced by the
+    # model zoo for parity: synthetic model HEADS are sized 10-way
+    # (logistic_regression.py:65-67) while labels only span this many.
+    synthetic_num_classes: int = 2
     # lower edge of the per-client lognormal size window (upper = 2x);
     # the default reproduces the reference's 500/1000 generator window
     synthetic_samples_per_client: int = 500

@@ -421,6 +421,7 @@ def get_dataset(cfg: DataConfig, num_clients: int,
         data = generate_synthetic(
             num_tasks=num_clients, alpha=cfg.synthetic_alpha,
             beta=cfg.synthetic_beta, num_dim=cfg.synthetic_dim,
+            num_classes=cfg.synthetic_num_classes,
             regression=cfg.synthetic_regression,
             min_num_samples=spc, max_num_samples=2 * spc)
         sizes = [len(y) for y in data.client_y]

@@ -263,11 +263,19 @@ def _flash3_lse_bwd(scale, causal, block_q, block_k, use_pallas, res,
 _flash3_lse.defvjp(_flash3_lse_fwd, _flash3_lse_bwd)
 
 
+# Largest block_q*block_k score tile the kernel may hold in VMEM (f32;
+# 512x512 = 1 MB — comfortable under the ~16 MB budget with q/k/v tiles
+# and scratch). Only the degenerate-divisor path can exceed it.
+_MAX_BLOCK_ELEMS = 512 * 512
+
+
 def on_tpu() -> bool:
-    try:
-        return jax.devices()[0].platform == "tpu"
-    except Exception:
-        return False
+    """Same detection as the quantization kernel (quant_kernel._on_tpu):
+    the axon-relay backend reports 'axon', not 'tpu' — a platform-name
+    check would silently route every flash call to the dense fallback on
+    the real chip."""
+    from fedtorch_tpu.ops.pallas.quant_kernel import _on_tpu
+    return _on_tpu()
 
 
 def _divisor_block(T: int, block: int) -> int:
@@ -296,12 +304,21 @@ def _prep(q, k, v, scale, block_q, block_k, force):
     block_k = _divisor_block(T, block_k)
     q3, k3, v3 = (t.transpose(0, 2, 1, 3).reshape(B * H, T, D)
                   for t in (q, k, v))
+    if force not in (None, "interpret", "xla"):
+        raise ValueError(
+            f"unknown force={force!r} (expected None, 'interpret', or "
+            "'xla')")
     if force == "interpret":
         use_pallas = None           # pallas_call(interpret=True)
     elif force == "xla" or not on_tpu():
         use_pallas = False
     else:
         use_pallas = True
+    if use_pallas and block_q * block_k > _MAX_BLOCK_ELEMS:
+        # degenerate divisor (prime-ish T) collapsed to near-T blocks:
+        # a [block_q, block_k] f32 score tile would blow VMEM on the
+        # real lowering — the XLA oracle is the correct backend there
+        use_pallas = False
     return (q3, k3, v3), (B, T, H, D), scale, block_q, block_k, \
         use_pallas
 

@@ -78,6 +78,26 @@ class TestForward:
                                    np.asarray(ref), atol=3e-2, rtol=3e-2)
 
 
+class TestBackendSelection:
+    def test_unknown_force_raises(self):
+        q, k, v = _qkv(T=32, D=16)
+        with pytest.raises(ValueError, match="force"):
+            flash_attention(q, k, v, force="interp")  # typo'd string
+
+    def test_degenerate_block_falls_back_to_xla(self, monkeypatch):
+        """A prime-ish T collapses the divisor blocks to ~T; on TPU the
+        [T, T] score tile would blow VMEM, so _prep must route the call
+        to the XLA oracle even when the platform offers pallas."""
+        import fedtorch_tpu.ops.pallas.flash_attention as fa
+        monkeypatch.setattr(fa, "on_tpu", lambda: True)
+        q, k, v = _qkv(T=1000, D=16)  # gcd(1000,128)=8<16 -> block=1000
+        *_, use_pallas = fa._prep(q, k, v, None, 128, 128, None)
+        assert use_pallas is False
+        q, k, v = _qkv(T=256, D=16)   # clean tiling stays on the kernel
+        *_, use_pallas = fa._prep(q, k, v, None, 128, 128, None)
+        assert use_pallas is True
+
+
 class TestGradients:
     @pytest.mark.parametrize("causal", [False, True])
     def test_custom_vjp_matches_dense_grads(self, causal):
