@@ -1,0 +1,47 @@
+#!/bin/bash
+# Wait for the TPU relay, then capture the FULL round-3 measurement
+# list sequentially (supersedes tpu_capture.sh's list; one relay
+# session, strictly serial — the 1-core host and single-session relay
+# both forbid concurrency). Run in the background from the repo root:
+#     nohup bash scripts/tpu_capture_full.sh > /tmp/tpu_capture.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.." || exit 1
+
+TRIES="${TPU_CAPTURE_WAIT_TRIES:-85}"   # ~5.7 h of patience by default
+
+echo "[tpu_capture_full] waiting for the relay (up to ${TRIES}x120s probes)"
+BENCH_PROBE_TRIES="$TRIES" python - <<'EOF'
+import sys
+sys.path.insert(0, ".")
+from bench import probe_device
+sys.exit(0 if probe_device() else 1)
+EOF
+if [ $? -ne 0 ]; then
+    echo "[tpu_capture_full] relay never recovered; nothing captured"
+    exit 1
+fi
+
+echo "[tpu_capture_full] relay alive — capturing (sequential)"
+FAILED=0
+run() {
+    echo "=== $* ==="
+    # probes are already done; don't let per-script probes re-wait long
+    BENCH_PROBE_TRIES=2 "$@"
+    local rc=$?
+    echo "=== rc=$rc ==="
+    [ $rc -ne 0 ] && FAILED=1
+}
+
+# A/B variants FIRST; the defaults run LAST so the persisted
+# TPU_BENCH_CAPTURE.json (wedged-relay report fallback) is the
+# default-config number, not a variant's
+run env BENCH_SINGLE_DISPATCH=0 python bench.py  # dispatch A/B
+run env BENCH_SCAN_UNROLL=4 python bench.py      # unroll A/B
+run python bench.py                              # -> TPU_BENCH_CAPTURE.json
+run python scripts/tpu_zoo_check.py              # -> TPU_ZOO.json
+run python scripts/pallas_tpu_check.py           # -> PALLAS_TPU.json (flash)
+run python scripts/flash_train_bench.py          # -> FLASH_TRAIN.json
+run python scripts/vmap_penalty_bench.py         # -> VMAP_PENALTY.json
+run python scripts/baseline_suite.py             # -> BASELINE_SUITE.json
+echo "[tpu_capture_full] done (failed=$FAILED)"
+exit $FAILED
