@@ -168,6 +168,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-c", "--checkpoint", default="./checkpoint/")
     p.add_argument("--save_all_models", type=str2bool, default=False)
     p.add_argument("--save_some_models", default="1,29,59")
+    p.add_argument("--async_checkpoint", action="store_true",
+                   help="write checkpoints from a background thread "
+                        "(atomic) so rounds never block on disk")
     p.add_argument("--check_model_at_sync", type=str2bool, default=False)
     p.add_argument("--track_model_aggregation", type=str2bool,
                    default=False)
@@ -287,6 +290,7 @@ def args_to_config(args) -> ExperimentConfig:
             checkpoint_index=args.checkpoint_index,
             save_all_models=args.save_all_models,
             save_some_models=args.save_some_models,
+            async_save=args.async_checkpoint,
             log_dir=args.log_dir, debug=args.debug,
             check_model_at_sync=args.check_model_at_sync,
             track_model_aggregation=args.track_model_aggregation),
@@ -371,72 +375,86 @@ def run_experiment(cfg: ExperimentConfig,
     schedule = trainer.schedule
     save_rounds = tuple(
         int(x) for x in cfg.checkpoint.save_some_models.split(","))
+    async_ckpt = None
+    if cfg.checkpoint.async_save:
+        from fedtorch_tpu.utils import AsyncCheckpointer
+        async_ckpt = AsyncCheckpointer()
     results = {}
     start_round = int(server.round)
-    for r in range(start_round, cfg.federated.num_comms):
-        timer.new_round()
-        # copy, not alias: the round jit donates the server buffers
-        prev_params = jax.tree.map(jnp.copy, server.params) \
-            if cfg.checkpoint.track_model_aggregation else None
-        timer.start("round")
-        server, clients, metrics = trainer.run_round(server, clients)
-        jax.block_until_ready(server.params)
-        round_time = timer.stop("round")
-        timer.add_comm(num_bytes=float(metrics.comm_bytes))
+    try:
+        for r in range(start_round, cfg.federated.num_comms):
+            timer.new_round()
+            # copy, not alias: the round jit donates the server buffers
+            prev_params = jax.tree.map(jnp.copy, server.params) \
+                if cfg.checkpoint.track_model_aggregation else None
+            timer.start("round")
+            server, clients, metrics = trainer.run_round(server, clients)
+            jax.block_until_ready(server.params)
+            round_time = timer.stop("round")
+            timer.add_comm(num_bytes=float(metrics.comm_bytes))
 
-        if cfg.checkpoint.check_model_at_sync:
-            norms = model_norms(server.params)
-            logger.log(f"Round {r}: server model l2="
-                       f"{float(norms['l2']):.4f} "
-                       f"max|w|={float(norms['max_abs']):.4f}")
-        if prev_params is not None:
-            tr = aggregation_tracking(prev_params, server.params)
-            logger.log(f"Round {r}: aggregation cosine="
-                       f"{float(tr['cosine']):.6f} "
-                       f"distance={float(tr['distance']):.6f}")
+            if cfg.checkpoint.check_model_at_sync:
+                norms = model_norms(server.params)
+                logger.log(f"Round {r}: server model l2="
+                           f"{float(norms['l2']):.4f} "
+                           f"max|w|={float(norms['max_abs']):.4f}")
+            if prev_params is not None:
+                tr = aggregation_tracking(prev_params, server.params)
+                logger.log(f"Round {r}: aggregation cosine="
+                           f"{float(tr['cosine']):.6f} "
+                           f"distance={float(tr['distance']):.6f}")
 
-        n_online = float(jnp.sum(metrics.online_mask))
-        loss = float(jnp.sum(metrics.train_loss) / max(n_online, 1))
-        acc = float(jnp.sum(metrics.train_acc) / max(n_online, 1))
-        epoch = trainer.mean_client_epoch(clients)
-        logger.log_train(r, epoch, loss, acc,
-                         float(lr_at(schedule, epoch)),
-                         comm_bytes=float(metrics.comm_bytes),
-                         round_time=round_time)
+            n_online = float(jnp.sum(metrics.online_mask))
+            loss = float(jnp.sum(metrics.train_loss) / max(n_online, 1))
+            acc = float(jnp.sum(metrics.train_acc) / max(n_online, 1))
+            epoch = trainer.mean_client_epoch(clients)
+            logger.log_train(r, epoch, loss, acc,
+                             float(lr_at(schedule, epoch)),
+                             comm_bytes=float(metrics.comm_bytes),
+                             round_time=round_time)
 
-        if (r + 1) % cfg.train.eval_freq == 0:
-            timer.start("eval")
-            res = evaluate(model, server.params, fed_data.test_x,
-                           fed_data.test_y)
-            timer.stop("eval")
-            top1 = float(res.top1)
-            is_best = top1 > best_prec1
-            best_prec1 = max(best_prec1, top1)
-            logger.log_val(r, "test", float(res.loss), top1,
-                           float(res.top5), best=best_prec1)
-            if cfg.train.per_class_acc:
-                from fedtorch_tpu.models.common import num_classes_of
-                from fedtorch_tpu.parallel import evaluate_per_class
-                accs, counts = evaluate_per_class(
-                    model, server.params, fed_data.test_x,
-                    fed_data.test_y, num_classes_of(cfg.data.dataset))
-                logger.log("Round: {}. Per-class acc: {}".format(
-                    r, [round(float(a), 4) for a in accs]))
+            if (r + 1) % cfg.train.eval_freq == 0:
+                timer.start("eval")
+                res = evaluate(model, server.params, fed_data.test_x,
+                               fed_data.test_y)
+                timer.stop("eval")
+                top1 = float(res.top1)
+                is_best = top1 > best_prec1
+                best_prec1 = max(best_prec1, top1)
+                logger.log_val(r, "test", float(res.loss), top1,
+                               float(res.top5), best=best_prec1)
+                if cfg.train.per_class_acc:
+                    from fedtorch_tpu.models.common import num_classes_of
+                    from fedtorch_tpu.parallel import evaluate_per_class
+                    accs, counts = evaluate_per_class(
+                        model, server.params, fed_data.test_x,
+                        fed_data.test_y, num_classes_of(cfg.data.dataset))
+                    logger.log("Round: {}. Per-class acc: {}".format(
+                        r, [round(float(a), 4) for a in accs]))
+                timer.start("checkpoint")
+                saver = async_ckpt.save if async_ckpt is not None \
+                    else save_checkpoint
+                saver(ckpt_dir, server, clients, cfg, best_prec1,
+                      is_best, save_all=cfg.checkpoint.save_all_models,
+                      save_some_rounds=save_rounds)
+                timer.stop("checkpoint")
+                if cfg.federated.personal and fed_data.val is not None \
+                        and cfg.effective_algorithm in (
+                            "apfl", "perfedme", "perfedavg"):
+                    _, _, summary = evaluate_personal(
+                        model, clients.aux, clients.params,
+                        trainer.val_data, cfg.effective_algorithm)
+                    logger.log_val(r, "validation_personal",
+                                   summary["loss_mean"],
+                                   summary["acc_mean"])
+                results["test_top1"] = top1
+    finally:
+        if async_ckpt is not None:
+            # flush pending writes even when the loop raised — the
+            # checkpoint the user would resume from must hit disk
             timer.start("checkpoint")
-            save_checkpoint(ckpt_dir, server, clients, cfg, best_prec1,
-                            is_best,
-                            save_all=cfg.checkpoint.save_all_models,
-                            save_some_rounds=save_rounds)
+            async_ckpt.close()
             timer.stop("checkpoint")
-            if cfg.federated.personal and fed_data.val is not None \
-                    and cfg.effective_algorithm in (
-                        "apfl", "perfedme", "perfedavg"):
-                _, _, summary = evaluate_personal(
-                    model, clients.aux, clients.params, trainer.val_data,
-                    cfg.effective_algorithm)
-                logger.log_val(r, "validation_personal",
-                               summary["loss_mean"], summary["acc_mean"])
-            results["test_top1"] = top1
     results["best_top1"] = best_prec1
     results["timer"] = timer.summary()
     logger.log(f"phase timers: {timer.summary()}")

@@ -222,6 +222,9 @@ class CheckpointConfig:
     checkpoint_index: Optional[str] = None
     save_all_models: bool = False
     save_some_models: str = "1,29,59"
+    # write checkpoints from a background thread (atomic tmp+rename)
+    # so training dispatch never blocks on serialization/disk
+    async_save: bool = False
     log_dir: str = "./logdir/"
     track_model_aggregation: bool = False
     check_model_at_sync: bool = False

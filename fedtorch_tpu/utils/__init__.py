@@ -1,6 +1,6 @@
 from fedtorch_tpu.utils.checkpoint import (  # noqa: F401
-    get_checkpoint_folder_name, init_checkpoint_dir, maybe_resume,
-    save_checkpoint,
+    AsyncCheckpointer, get_checkpoint_folder_name, init_checkpoint_dir,
+    maybe_resume, save_checkpoint,
 )
 from fedtorch_tpu.utils.diagnostics import (  # noqa: F401
     aggregation_tracking, check_finite, model_norms,

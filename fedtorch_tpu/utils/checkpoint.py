@@ -19,7 +19,6 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-import shutil
 import time
 from typing import Optional, Tuple
 
@@ -82,35 +81,135 @@ def _strip_padding(clients, num_clients: int):
     return jax.tree.map(lambda x: x[:num_clients], clients)
 
 
-def save_checkpoint(directory: str, server, clients,
-                    cfg: ExperimentConfig, best_prec1: float,
-                    is_best: bool, save_all: bool = False,
-                    save_some_rounds: Tuple[int, ...] = ()) -> str:
-    """Serialize the full round state (checkpoint.py:68-82 semantics)."""
+def _snapshot(server, clients, cfg: ExperimentConfig):
+    """Device -> host copy of the serializable round state. Blocks until
+    the state is materialized (so the snapshot is consistent), after
+    which serialization/IO can proceed off-thread."""
+    state = {"server": _unkey(server),
+             "clients": _strip_padding(clients,
+                                       cfg.federated.num_clients)}
+    return jax.device_get(state)
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    """tmp + fsync + rename so a crash (including power loss — without
+    the fsync, delayed allocation could rename before the data blocks
+    hit disk) never corrupts the previous checkpoint. The reference
+    overwrites in place (checkpoint.py:72)."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _write_checkpoint(directory: str, host_state, meta: dict,
+                      is_best: bool, round_idx: int,
+                      save_all: bool,
+                      save_some_rounds: Tuple[int, ...]) -> str:
+    """Serialize + write an already-host-resident snapshot (the worker
+    half of both the sync and async paths)."""
     os.makedirs(directory, exist_ok=True)
-    payload = serialization.to_bytes(
-        {"server": _unkey(server),
-         "clients": _strip_padding(clients, cfg.federated.num_clients)})
-    round_idx = int(server.round)
+    payload = serialization.to_bytes(host_state)
     path = os.path.join(directory, "checkpoint.ckpt")
-    with open(path, "wb") as f:
-        f.write(payload)
-    meta = {
+    _atomic_write(path, payload)
+    meta_bytes = json.dumps(meta, default=str).encode()
+    _atomic_write(os.path.join(directory, "checkpoint.json"), meta_bytes)
+    if is_best:
+        _atomic_write(os.path.join(directory, "model_best.ckpt"), payload)
+        _atomic_write(os.path.join(directory, "model_best.json"),
+                      meta_bytes)
+    if save_all or round_idx in save_some_rounds:
+        _atomic_write(
+            os.path.join(directory, f"checkpoint_r{round_idx}.ckpt"),
+            payload)
+    return path
+
+
+def _meta_for(cfg: ExperimentConfig, round_idx: int,
+              best_prec1: float) -> dict:
+    return {
         "arguments": _compat_meta(cfg),
         "round": round_idx,
         "best_prec1": best_prec1,
         "config": dataclasses.asdict(cfg),
     }
-    with open(os.path.join(directory, "checkpoint.json"), "w") as f:
-        json.dump(meta, f, default=str)
-    if is_best:
-        shutil.copyfile(path, os.path.join(directory, "model_best.ckpt"))
-        shutil.copyfile(os.path.join(directory, "checkpoint.json"),
-                        os.path.join(directory, "model_best.json"))
-    if save_all or round_idx in save_some_rounds:
-        shutil.copyfile(
-            path, os.path.join(directory, f"checkpoint_r{round_idx}.ckpt"))
-    return path
+
+
+def save_checkpoint(directory: str, server, clients,
+                    cfg: ExperimentConfig, best_prec1: float,
+                    is_best: bool, save_all: bool = False,
+                    save_some_rounds: Tuple[int, ...] = ()) -> str:
+    """Serialize the full round state (checkpoint.py:68-82 semantics),
+    synchronously. See :class:`AsyncCheckpointer` for the non-blocking
+    variant."""
+    round_idx = int(server.round)
+    return _write_checkpoint(
+        directory, _snapshot(server, clients, cfg),
+        _meta_for(cfg, round_idx, best_prec1), is_best, round_idx,
+        save_all, save_some_rounds)
+
+
+class AsyncCheckpointer:
+    """Non-blocking checkpoint writer: :meth:`save` snapshots the round
+    state to host memory on the caller thread (consistent by
+    construction — device_get blocks until the round's arrays are
+    ready), then a single worker thread serializes and atomically writes
+    it, so training dispatch never waits on msgpack or disk. Bounded
+    backpressure: at most TWO snapshots are outstanding (one being
+    written, one queued — host memory holds ≤2 host-state copies); a
+    third save blocks until the oldest write finishes. Every requested
+    checkpoint is durably written — latest-wins dropping would silently
+    lose 'best' copies.
+
+    Call :meth:`wait` before reading checkpoints back or at run end."""
+
+    def __init__(self):
+        import queue
+        import threading
+        self._q: "queue.Queue" = queue.Queue(maxsize=1)
+        self._errors: list = []
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        while True:
+            job = self._q.get()
+            if job is None:
+                self._q.task_done()
+                return
+            try:
+                _write_checkpoint(*job)
+            except Exception as e:  # surfaced on the next save()/wait()
+                self._errors.append(e)
+            finally:
+                self._q.task_done()
+
+    def _raise_pending(self):
+        if self._errors:
+            raise RuntimeError(
+                "async checkpoint write failed") from self._errors.pop(0)
+
+    def save(self, directory: str, server, clients,
+             cfg: ExperimentConfig, best_prec1: float, is_best: bool,
+             save_all: bool = False,
+             save_some_rounds: Tuple[int, ...] = ()) -> None:
+        self._raise_pending()
+        round_idx = int(server.round)
+        self._q.put((directory, _snapshot(server, clients, cfg),
+                     _meta_for(cfg, round_idx, best_prec1), is_best,
+                     round_idx, save_all, save_some_rounds))
+
+    def wait(self) -> None:
+        """Block until every enqueued checkpoint is on disk."""
+        self._q.join()
+        self._raise_pending()
+
+    def close(self) -> None:
+        self.wait()
+        self._q.put(None)
+        self._thread.join(timeout=30)
 
 
 def maybe_resume(directory: Optional[str], server, clients,

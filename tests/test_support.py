@@ -180,6 +180,59 @@ class TestCheckpoint:
         with pytest.raises(FileNotFoundError):
             maybe_resume(str(tmp_path / "nope"), None, None, cfg, None)
 
+    def test_async_checkpointer_matches_sync(self, tmp_path):
+        """AsyncCheckpointer writes the same bytes as save_checkpoint,
+        resumes identically, and leaves no tmp files behind (atomic
+        rename)."""
+        from fedtorch_tpu.utils import AsyncCheckpointer
+        cfg = _cfg(tmp_path)
+        data = build_federated_data(cfg)
+        model = define_model(cfg, batch_size=10)
+        trainer = FederatedTrainer(cfg, model, make_algorithm(cfg),
+                                   data.train)
+        server, clients = trainer.init_state(jax.random.key(0))
+        server, clients, _ = trainer.run_round(server, clients)
+
+        save_checkpoint(str(tmp_path / "sync"), server, clients, cfg,
+                        best_prec1=0.4, is_best=True)
+        ck = AsyncCheckpointer()
+        ck.save(str(tmp_path / "async"), server, clients, cfg,
+                best_prec1=0.4, is_best=True)
+        ck.close()
+
+        sync_bytes = (tmp_path / "sync" / "checkpoint.ckpt").read_bytes()
+        async_bytes = (tmp_path / "async"
+                       / "checkpoint.ckpt").read_bytes()
+        assert sync_bytes == async_bytes
+        assert (tmp_path / "async" / "model_best.ckpt").exists()
+        assert not list((tmp_path / "async").glob("*.tmp"))
+
+        s2, c2 = trainer.init_state(jax.random.key(0))
+        s2, _, best, resumed = maybe_resume(str(tmp_path / "async"), s2,
+                                            c2, cfg, None)
+        assert resumed and best == 0.4 and int(s2.round) == 1
+
+    def test_async_checkpointer_surfaces_write_errors(self, tmp_path):
+        """A failed background write must raise on the next save/wait,
+        not vanish."""
+        from fedtorch_tpu.utils import AsyncCheckpointer
+        cfg = _cfg(tmp_path)
+        data = build_federated_data(cfg)
+        model = define_model(cfg, batch_size=10)
+        trainer = FederatedTrainer(cfg, model, make_algorithm(cfg),
+                                   data.train)
+        server, clients = trainer.init_state(jax.random.key(0))
+        blocker = tmp_path / "blocked"
+        blocker.write_text("a file where a directory must go")
+        ck = AsyncCheckpointer()
+        try:
+            ck.save(str(blocker / "sub"), server, clients, cfg, 0.0,
+                    False)
+            with pytest.raises(RuntimeError, match="async checkpoint"):
+                ck.wait()
+        finally:
+            ck.close()  # wait() popped the error; close is clean
+
 
 class TestCLI:
     def test_end_to_end_federated(self, tmp_path):
