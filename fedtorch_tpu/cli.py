@@ -451,10 +451,21 @@ def run_experiment(cfg: ExperimentConfig,
     finally:
         if async_ckpt is not None:
             # flush pending writes even when the loop raised — the
-            # checkpoint the user would resume from must hit disk
+            # checkpoint the user would resume from must hit disk. A
+            # flush failure must not MASK an in-flight training
+            # exception (we are inside its finally).
+            in_flight = sys.exc_info()[0] is not None
             timer.start("checkpoint")
-            async_ckpt.close()
-            timer.stop("checkpoint")
+            try:
+                async_ckpt.close()
+            except Exception as e:
+                if in_flight:
+                    logger.log("WARNING: async checkpoint flush failed "
+                               f"while handling another error: {e}")
+                else:
+                    raise
+            finally:
+                timer.stop("checkpoint")
     results["best_top1"] = best_prec1
     results["timer"] = timer.summary()
     logger.log(f"phase timers: {timer.summary()}")

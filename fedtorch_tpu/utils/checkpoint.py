@@ -82,13 +82,20 @@ def _strip_padding(clients, num_clients: int):
 
 
 def _snapshot(server, clients, cfg: ExperimentConfig):
-    """Device -> host copy of the serializable round state. Blocks until
-    the state is materialized (so the snapshot is consistent), after
-    which serialization/IO can proceed off-thread."""
+    """Device -> host DEEP copy of the serializable round state. Blocks
+    until the state is materialized (so the snapshot is consistent),
+    after which serialization/IO can proceed off-thread.
+
+    The explicit np.array copy matters: on the CPU backend,
+    ``device_get`` can return zero-copy VIEWS of device buffers, and the
+    round jit donates those buffers (federated.py donate_argnums) — an
+    aliased snapshot would race with the next round's dispatch."""
+    import numpy as np
     state = {"server": _unkey(server),
              "clients": _strip_padding(clients,
                                        cfg.federated.num_clients)}
-    return jax.device_get(state)
+    return jax.tree.map(lambda x: np.array(x, copy=True),
+                        jax.device_get(state))
 
 
 def _atomic_write(path: str, data: bytes) -> None:
@@ -137,13 +144,27 @@ def _meta_for(cfg: ExperimentConfig, round_idx: int,
     }
 
 
+def _is_writer_process() -> bool:
+    """Multi-host runs replicate the server state on every process;
+    only process 0 writes (the reference's rank-0 checkpointing,
+    eval.py:120-144) — N identical writers would race on the same
+    files for no benefit."""
+    try:
+        return jax.process_index() == 0
+    except Exception:
+        return True
+
+
 def save_checkpoint(directory: str, server, clients,
                     cfg: ExperimentConfig, best_prec1: float,
                     is_best: bool, save_all: bool = False,
                     save_some_rounds: Tuple[int, ...] = ()) -> str:
     """Serialize the full round state (checkpoint.py:68-82 semantics),
     synchronously. See :class:`AsyncCheckpointer` for the non-blocking
-    variant."""
+    variant. No-op (returning the path) off process 0."""
+    path = os.path.join(directory, "checkpoint.ckpt")
+    if not _is_writer_process():
+        return path
     round_idx = int(server.round)
     return _write_checkpoint(
         directory, _snapshot(server, clients, cfg),
@@ -157,11 +178,12 @@ class AsyncCheckpointer:
     construction — device_get blocks until the round's arrays are
     ready), then a single worker thread serializes and atomically writes
     it, so training dispatch never waits on msgpack or disk. Bounded
-    backpressure: at most TWO snapshots are outstanding (one being
-    written, one queued — host memory holds ≤2 host-state copies); a
-    third save blocks until the oldest write finishes. Every requested
-    checkpoint is durably written — latest-wins dropping would silently
-    lose 'best' copies.
+    backpressure: one snapshot being written + one queued, and a third
+    ``save`` builds its snapshot then blocks in the queue until the
+    oldest write finishes — so host memory holds at most THREE
+    host-state copies transiently. Every requested checkpoint is
+    durably written — latest-wins dropping would silently lose 'best'
+    copies.
 
     Call :meth:`wait` before reading checkpoints back or at run end."""
 
@@ -196,6 +218,8 @@ class AsyncCheckpointer:
              save_all: bool = False,
              save_some_rounds: Tuple[int, ...] = ()) -> None:
         self._raise_pending()
+        if not _is_writer_process():
+            return
         round_idx = int(server.round)
         self._q.put((directory, _snapshot(server, clients, cfg),
                      _meta_for(cfg, round_idx, best_prec1), is_best,
@@ -207,9 +231,13 @@ class AsyncCheckpointer:
         self._raise_pending()
 
     def close(self) -> None:
-        self.wait()
-        self._q.put(None)
-        self._thread.join(timeout=30)
+        try:
+            self.wait()
+        finally:
+            # shut the worker down even when wait() surfaced a write
+            # error — library users must not leak the thread
+            self._q.put(None)
+            self._thread.join(timeout=30)
 
 
 def maybe_resume(directory: Optional[str], server, clients,

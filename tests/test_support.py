@@ -212,6 +212,26 @@ class TestCheckpoint:
                                             c2, cfg, None)
         assert resumed and best == 0.4 and int(s2.round) == 1
 
+    def test_non_writer_process_skips_io(self, tmp_path, monkeypatch):
+        """Off process 0 (multi-host), checkpoint saves are no-ops —
+        the state is replicated, so N identical writers would race on
+        the same files (reference: rank-0-only, eval.py:120-144)."""
+        import fedtorch_tpu.utils.checkpoint as ckpt_mod
+        monkeypatch.setattr(ckpt_mod.jax, "process_index", lambda: 1)
+        cfg = _cfg(tmp_path)
+        data = build_federated_data(cfg)
+        model = define_model(cfg, batch_size=10)
+        trainer = FederatedTrainer(cfg, model, make_algorithm(cfg),
+                                   data.train)
+        server, clients = trainer.init_state(jax.random.key(0))
+        save_checkpoint(str(tmp_path / "p1"), server, clients, cfg,
+                        0.0, True)
+        from fedtorch_tpu.utils import AsyncCheckpointer
+        ck = AsyncCheckpointer()
+        ck.save(str(tmp_path / "p1"), server, clients, cfg, 0.0, True)
+        ck.close()
+        assert not (tmp_path / "p1").exists()
+
     def test_async_checkpointer_surfaces_write_errors(self, tmp_path):
         """A failed background write must raise on the next save/wait,
         not vanish."""
