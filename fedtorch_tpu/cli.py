@@ -381,6 +381,7 @@ def run_experiment(cfg: ExperimentConfig,
         async_ckpt = AsyncCheckpointer()
     results = {}
     start_round = int(server.round)
+    loop_raised = False
     try:
         for r in range(start_round, cfg.federated.num_comms):
             timer.new_round()
@@ -448,18 +449,22 @@ def run_experiment(cfg: ExperimentConfig,
                                    summary["loss_mean"],
                                    summary["acc_mean"])
                 results["test_top1"] = top1
+    except BaseException:
+        loop_raised = True
+        raise
     finally:
         if async_ckpt is not None:
             # flush pending writes even when the loop raised — the
             # checkpoint the user would resume from must hit disk. A
-            # flush failure must not MASK an in-flight training
-            # exception (we are inside its finally).
-            in_flight = sys.exc_info()[0] is not None
+            # flush failure must not MASK the loop's own exception, but
+            # must still raise when the loop succeeded (sys.exc_info()
+            # can't distinguish the two: it also reports exceptions
+            # being handled further up the call stack).
             timer.start("checkpoint")
             try:
                 async_ckpt.close()
             except Exception as e:
-                if in_flight:
+                if loop_raised:
                     logger.log("WARNING: async checkpoint flush failed "
                                f"while handling another error: {e}")
                 else:

@@ -20,6 +20,8 @@ import dataclasses
 import json
 import os
 import time
+
+import numpy as np
 from typing import Optional, Tuple
 
 import jax
@@ -81,21 +83,41 @@ def _strip_padding(clients, num_clients: int):
     return jax.tree.map(lambda x: x[:num_clients], clients)
 
 
+def _owning_host_copy(x):
+    """An OWNING host array: on the CPU backend ``device_get`` can hand
+    back zero-copy VIEWS of device buffers, and the round jit donates
+    those buffers (federated.py donate_argnums) — an aliased snapshot
+    would race with the next round's dispatch. Arrays that already own
+    their data (the TPU device_get result) pass through uncopied."""
+    if isinstance(x, np.ndarray) and x.flags["OWNDATA"]:
+        return x
+    return np.array(x, copy=True)
+
+
 def _snapshot(server, clients, cfg: ExperimentConfig):
-    """Device -> host DEEP copy of the serializable round state. Blocks
+    """Device -> host copy of the serializable round state. Blocks
     until the state is materialized (so the snapshot is consistent),
     after which serialization/IO can proceed off-thread.
 
-    The explicit np.array copy matters: on the CPU backend,
-    ``device_get`` can return zero-copy VIEWS of device buffers, and the
-    round jit donates those buffers (federated.py donate_argnums) — an
-    aliased snapshot would race with the next round's dispatch."""
-    import numpy as np
+    Multi-host: client state is SHARDED across processes
+    (shard_clients), so a plain device_get on one process would touch
+    non-addressable shards; the cross-host allgather materializes the
+    global value on every process. It is a COLLECTIVE — every process
+    must call _snapshot even though only process 0 writes."""
     state = {"server": _unkey(server),
              "clients": _strip_padding(clients,
                                        cfg.federated.num_clients)}
-    return jax.tree.map(lambda x: np.array(x, copy=True),
-                        jax.device_get(state))
+
+    def to_host(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            # sharded across processes (the client axis): collective
+            # gather of the GLOBAL value
+            from jax.experimental import multihost_utils
+            return multihost_utils.process_allgather(x, tiled=True)
+        return jax.device_get(x)
+
+    return jax.tree.map(_owning_host_copy,
+                        jax.tree.map(to_host, state))
 
 
 def _atomic_write(path: str, data: bytes) -> None:
@@ -145,9 +167,9 @@ def _meta_for(cfg: ExperimentConfig, round_idx: int,
 
 
 def _is_writer_process() -> bool:
-    """Multi-host runs replicate the server state on every process;
-    only process 0 writes (the reference's rank-0 checkpointing,
-    eval.py:120-144) — N identical writers would race on the same
+    """Only process 0 writes (the reference's rank-0 checkpointing,
+    eval.py:120-144) — after the collective snapshot every process
+    holds the same gathered state, so N writers would race on the same
     files for no benefit."""
     try:
         return jax.process_index() == 0
@@ -161,13 +183,15 @@ def save_checkpoint(directory: str, server, clients,
                     save_some_rounds: Tuple[int, ...] = ()) -> str:
     """Serialize the full round state (checkpoint.py:68-82 semantics),
     synchronously. See :class:`AsyncCheckpointer` for the non-blocking
-    variant. No-op (returning the path) off process 0."""
+    variant. Every process participates in the snapshot (it is a
+    collective on multi-host); only process 0 touches the disk."""
     path = os.path.join(directory, "checkpoint.ckpt")
+    host_state = _snapshot(server, clients, cfg)
     if not _is_writer_process():
         return path
     round_idx = int(server.round)
     return _write_checkpoint(
-        directory, _snapshot(server, clients, cfg),
+        directory, host_state,
         _meta_for(cfg, round_idx, best_prec1), is_best, round_idx,
         save_all, save_some_rounds)
 
@@ -218,10 +242,13 @@ class AsyncCheckpointer:
              save_all: bool = False,
              save_some_rounds: Tuple[int, ...] = ()) -> None:
         self._raise_pending()
+        # the snapshot is a COLLECTIVE on multi-host — all processes
+        # take it; only process 0 enqueues the write
+        host_state = _snapshot(server, clients, cfg)
         if not _is_writer_process():
             return
         round_idx = int(server.round)
-        self._q.put((directory, _snapshot(server, clients, cfg),
+        self._q.put((directory, host_state,
                      _meta_for(cfg, round_idx, best_prec1), is_best,
                      round_idx, save_all, save_some_rounds))
 

@@ -62,6 +62,27 @@ for _ in range(2):
     server, clients, metrics = trainer.run_round(server, clients)
 jax.block_until_ready(server.params)
 
+# checkpoint across hosts: the snapshot is a COLLECTIVE (client state
+# is sharded across the two processes and must be allgathered); only
+# process 0 writes. Both processes MUST make the call.
+ckpt_dir = sys.argv[3] if len(sys.argv) > 3 else None
+if ckpt_dir:
+    from jax.experimental import multihost_utils
+    from fedtorch_tpu.utils import maybe_resume, save_checkpoint
+    save_checkpoint(ckpt_dir, server, clients, cfg, best_prec1=0.25,
+                    is_best=False)
+    if pid == 0:
+        assert os.path.exists(os.path.join(ckpt_dir, "checkpoint.ckpt"))
+    # barrier: process 1 must not read before process 0's write lands
+    multihost_utils.sync_global_devices("checkpoint-written")
+    # resume restores the sharded state on BOTH processes
+    s2, c2 = trainer.init_state(jax.random.key(1))
+    s2, c2, best, resumed = maybe_resume(ckpt_dir, s2, c2, cfg, None)
+    assert resumed and best == 0.25 and int(s2.round) == 2
+    server2, clients2, m2 = trainer.run_round(s2, c2)
+    jax.block_until_ready(server2.params)
+    print(f"MULTIHOST_CKPT_OK pid={pid}", flush=True)
+
 # replicated scalars are fetchable on every host
 loss = float(metrics.train_loss.sum()) / 10.0
 epoch = trainer.mean_client_epoch(clients)

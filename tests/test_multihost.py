@@ -37,9 +37,10 @@ def test_two_process_round(tmp_path):
     env["PYTHONPATH"] = os.pathsep.join(
         [os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
          env.get("PYTHONPATH", "")])
+    ckpt_dir = str(tmp_path / "mh_ckpt")
     procs = [
         subprocess.Popen(
-            [sys.executable, script, str(port), str(pid)],
+            [sys.executable, script, str(port), str(pid), ckpt_dir],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=env)
         for pid in (0, 1)
@@ -56,6 +57,10 @@ def test_two_process_round(tmp_path):
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid} failed:\n{out}"
         assert "MULTIHOST_OK" in out, out
+        # the collective checkpoint snapshot + process-0 write + resume
+        # ran on both processes
+        assert "MULTIHOST_CKPT_OK" in out, out
+    assert os.path.exists(os.path.join(ckpt_dir, "checkpoint.ckpt"))
     # identical training trajectory on both hosts (shared-seed contract)
     metrics = [re.search(r"MULTIHOST_OK pid=\d (.*)$", out, re.M).group(1)
                for out in outs]
