@@ -309,24 +309,20 @@ def long_context_apply(module: TransformerLM, params, tokens, mesh,
     ``strategy``: 'ring' (K/V rotation, any head count) or 'ulysses'
     (head-parallel all-to-all; needs heads % mesh size == 0) — see
     parallel/sequence.py for the memory/ICI trade. ``block_impl='flash'``
-    (ring only) attends each rotating block through the fused flash
-    kernel — the Ring Attention paper's blockwise-kernel form."""
+    attends through the fused flash kernel: per rotating K/V block for
+    the ring (the Ring Attention paper's blockwise-kernel form), or for
+    the local full-sequence head slice under ulysses."""
     from fedtorch_tpu.parallel.sequence import ring_attention, \
         ulysses_attention
 
     if strategy not in ("ring", "ulysses"):
         raise ValueError(f"unknown sequence-parallel strategy {strategy!r}")
-    if strategy == "ulysses" and block_impl != "dense":
-        raise ValueError(
-            "block_impl applies to the ring strategy only (ulysses "
-            "attends the full sequence per head slice); got "
-            f"block_impl={block_impl!r} with strategy='ulysses'")
 
     def attn(q, k, v):
         if strategy == "ring":
             return ring_attention(q, k, v, mesh, axis_name=axis_name,
                                   causal=True, block_impl=block_impl)
         return ulysses_attention(q, k, v, mesh, axis_name=axis_name,
-                                 causal=True)
+                                 causal=True, block_impl=block_impl)
 
     return module.apply({"params": params}, tokens, attn_override=attn)

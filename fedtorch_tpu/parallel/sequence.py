@@ -226,7 +226,7 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
 
 def _ulysses_local(q, k, v, *, axis_name: str, causal: bool,
-                   scale: float):
+                   scale: float, block_impl: str = "dense"):
     """Per-shard body: head-parallel attention via two all-to-alls.
 
     In: [B, T/n, H, D] (sequence-sharded). First all-to-all re-shards to
@@ -236,7 +236,9 @@ def _ulysses_local(q, k, v, *, axis_name: str, causal: bool,
     activations — independent of sequence length — vs the ring's
     (n-1) K/V rotations; the trade is all-to-all bandwidth against
     score memory: full-T scores for the local head slice here
-    (O(T^2 * H/n)) vs the ring's per-step block (O(T^2/n^2))."""
+    (O(T^2 * H/n)) vs the ring's per-step block (O(T^2/n^2)).
+    ``block_impl='flash'`` runs the local attention through the fused
+    flash kernel, shrinking that score memory to O(block²) tiles."""
     # split heads (axis 2) across the mesh, concatenate sequence (axis 1)
     q = jax.lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1,
                            tiled=True)
@@ -244,7 +246,12 @@ def _ulysses_local(q, k, v, *, axis_name: str, causal: bool,
                            tiled=True)
     v = jax.lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1,
                            tiled=True)
-    o = reference_attention(q, k, v, causal=causal, scale=scale)
+    if block_impl == "flash":
+        from fedtorch_tpu.ops.pallas.flash_attention import \
+            flash_attention
+        o = flash_attention(q, k, v, causal=causal, scale=scale)
+    else:
+        o = reference_attention(q, k, v, causal=causal, scale=scale)
     # inverse exchange: back to sequence-sharded, all heads
     return jax.lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2,
                               tiled=True)
@@ -253,23 +260,30 @@ def _ulysses_local(q, k, v, *, axis_name: str, causal: bool,
 def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                       mesh: Mesh, axis_name: str = "sp",
                       causal: bool = False,
-                      scale: Optional[float] = None) -> jnp.ndarray:
+                      scale: Optional[float] = None,
+                      block_impl: str = "dense") -> jnp.ndarray:
     """Exact all-to-all (DeepSpeed-Ulysses-style, arXiv:2309.14509)
     sequence parallelism: the alternative context-parallel strategy to
     :func:`ring_attention`, preferred when head count >= mesh size and
-    per-device memory can hold full-sequence scores for its head slice
-    (the all-to-alls move a fixed 2x-activations volume over ICI instead
-    of rotating K/V n-1 times).
+    per-device memory can hold the local head slice's attention (the
+    all-to-alls move a fixed 2x-activations volume over ICI instead of
+    rotating K/V n-1 times).
 
     Inputs/outputs [batch, seq, heads, head_dim]; both ``seq`` and
-    ``heads`` must divide evenly over the mesh axis."""
+    ``heads`` must divide evenly over the mesh axis. ``block_impl``:
+    'dense' materializes the local [T, T] scores; 'flash' runs the
+    local attention through the fused flash kernel (O(block²) score
+    tiles)."""
     n = mesh.shape[axis_name]
     if q.shape[2] % n:
         raise ValueError(
             f"ulysses needs heads ({q.shape[2]}) divisible by the "
             f"'{axis_name}' mesh axis ({n}); use ring_attention instead")
-    return _seq_sharded_call(_ulysses_local, q, k, v, mesh, axis_name,
-                             causal, scale)
+    if block_impl not in ("dense", "flash"):
+        raise ValueError(f"unknown ulysses block_impl {block_impl!r}")
+    local = functools.partial(_ulysses_local, block_impl=block_impl)
+    return _seq_sharded_call(local, q, k, v, mesh, axis_name, causal,
+                             scale)
 
 
 def reference_attention(q, k, v, causal: bool = False,

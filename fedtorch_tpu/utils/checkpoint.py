@@ -241,10 +241,13 @@ class AsyncCheckpointer:
              cfg: ExperimentConfig, best_prec1: float, is_best: bool,
              save_all: bool = False,
              save_some_rounds: Tuple[int, ...] = ()) -> None:
-        self._raise_pending()
         # the snapshot is a COLLECTIVE on multi-host — all processes
-        # take it; only process 0 enqueues the write
+        # take it FIRST (raising a pending error before it would leave
+        # the other processes blocked inside the allgather: only
+        # process 0 ever has pending write errors); only process 0
+        # enqueues the write
         host_state = _snapshot(server, clients, cfg)
+        self._raise_pending()
         if not _is_writer_process():
             return
         round_idx = int(server.round)

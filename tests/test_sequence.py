@@ -122,6 +122,34 @@ class TestUlysses:
         np.testing.assert_allclose(np.asarray(uly), np.asarray(ring),
                                    atol=2e-5, rtol=2e-5)
 
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_flash_local_matches_dense(self, causal):
+        """block_impl='flash': the local full-sequence attention runs
+        the flash kernel between the two all-to-alls — exact."""
+        q, k, v = _qkv(s=64, seed=13)
+        out = ulysses_attention(q, k, v, _mesh(4), causal=causal,
+                                block_impl="flash")
+        ref = reference_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_flash_local_gradients_match_oracle(self):
+        """The flash custom VJP composed with the two all-to-alls under
+        shard_map: gradients == dense attention's."""
+        q, k, v = _qkv(s=64, seed=15)
+        mesh = _mesh(4)
+        gf = jax.grad(lambda q: jnp.sum(ulysses_attention(
+            q, k, v, mesh, causal=True, block_impl="flash") ** 2))(q)
+        gr = jax.grad(lambda q: jnp.sum(reference_attention(
+            q, k, v, causal=True) ** 2))(q)
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   atol=5e-5, rtol=5e-4)
+
+    def test_rejects_unknown_block_impl(self):
+        q, k, v = _qkv()
+        with pytest.raises(ValueError, match="block_impl"):
+            ulysses_attention(q, k, v, _mesh(2), block_impl="sparse")
+
     def test_rejects_indivisible_heads(self):
         q, k, v = _qkv(h=4)
         with pytest.raises(ValueError, match="divisible"):
@@ -185,16 +213,20 @@ def test_sequence_parallel_training_step():
     assert sp_losses[-1] < sp_losses[0]
 
 
-def test_long_context_apply_rejects_ulysses_block_impl():
+def test_long_context_apply_ulysses_flash_matches_dense():
+    """block_impl='flash' under ulysses runs the LOCAL head-slice
+    attention through the flash kernel — same logits."""
     from fedtorch_tpu.models.transformer import TransformerLM, \
         long_context_apply
     model = TransformerLM(vocab_size=32, d_model=16, num_heads=2,
-                          num_layers=1, max_len=16)
-    toks = jax.random.randint(jax.random.key(1), (2, 16), 0, 32)
+                          num_layers=1, max_len=64)
+    toks = jax.random.randint(jax.random.key(1), (2, 64), 0, 32)
     params = model.init(jax.random.key(0), toks)["params"]
-    with pytest.raises(ValueError, match="ring strategy only"):
-        long_context_apply(model, params, toks, _mesh(2),
-                           strategy="ulysses", block_impl="flash")
+    ref = model.apply({"params": params}, toks)
+    out = long_context_apply(model, params, toks, _mesh(2),
+                             strategy="ulysses", block_impl="flash")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
 
 
 def test_long_context_apply_strategies_agree():
