@@ -81,6 +81,11 @@ def test_federated_training_converges():
     ("fedgate", {"compressed": True, "compressed_ratio": 0.5}),
     ("qsparse", {"compressed": True, "compressed_ratio": 0.5}),
     ("apfl", {"personal": True}),
+    # the two hardest hooks: DRFA's two-phase round (kth-model snapshot
+    # + second sampling + dual update) and qFFL's full-data loss pass
+    ("fedavg", {"drfa": True, "drfa_gamma": 0.1,
+                "online_client_rate": 0.5}),
+    ("qffl", {"qffl_q": 1.0}),
 ])
 def test_algorithm_zoo_composes_with_transformer(algorithm, fed_kw):
     """The aggregation families are pytree-generic: control variates,
@@ -98,10 +103,10 @@ def test_algorithm_zoo_composes_with_transformer(algorithm, fed_kw):
     data = stack_partitions(x, y, parts)
     cfg = ExperimentConfig(
         data=DataConfig(dataset="shakespeare", batch_size=4),
-        federated=FederatedConfig(federated=True, num_clients=4,
-                                  online_client_rate=1.0,
-                                  algorithm=algorithm,
-                                  sync_type="local_step", **fed_kw),
+        federated=FederatedConfig(**{
+            "federated": True, "num_clients": 4,
+            "online_client_rate": 1.0, "algorithm": algorithm,
+            "sync_type": "local_step", **fed_kw}),
         model=ModelConfig(arch="transformer", rnn_seq_len=16,
                           rnn_hidden_size=8, mlp_num_layers=1,
                           moe_experts=2, moe_capacity_factor=1.5),
