@@ -123,8 +123,13 @@ class MoEMLP(nn.Module):
             capacity = max(
                 1, math.ceil(self.capacity_factor * x.shape[0]
                              * x.shape[1] / E))
+            plan = moe_dispatch_plan(sel, E, capacity)
+            # observability for the capacity knob: fraction of tokens
+            # whose MoE contribution was dropped to the residual
+            self.sow("intermediates", "drop_fraction",
+                     1.0 - jnp.mean(plan[1].astype(jnp.float32)))
             out = moe_sparse_compute(x.astype(dt), sel, w_in, b_in,
-                                     w_out, b_out, capacity)
+                                     w_out, b_out, capacity, plan=plan)
         else:
             onehot = jax.nn.one_hot(sel, E, dtype=dt)       # [B, T, E]
             out = moe_expert_compute(x.astype(dt), onehot, w_in, b_in,
@@ -179,18 +184,22 @@ def moe_expert_mlp(expert_in, w_in, b_in, w_out, b_out):
     return jnp.einsum("ecf,efd->ecd", h, w_out) + b_out[:, None]
 
 
-def moe_sparse_compute(x, sel, w_in, b_in, w_out, b_out, capacity: int):
+def moe_sparse_compute(x, sel, w_in, b_in, w_out, b_out, capacity: int,
+                       plan=None):
     """Capacity-bounded Switch dispatch: gather each expert's routed
     tokens into [E, C, D], run the expert MLPs as one batched matmul,
     scatter results back. FLOPs = capacity_factor × the dense MLP cost.
     Equals :func:`moe_expert_compute` exactly whenever no expert
     overflows ``capacity``; overflowing tokens contribute 0 (dropped).
-    Caller applies the gate-probability scaling."""
+    Caller applies the gate-probability scaling. ``plan`` lets a caller
+    that already computed :func:`moe_dispatch_plan` (the module sows
+    drop stats from it) avoid tracing the dispatch twice."""
     B, T, D = x.shape
     E = w_in.shape[0]
     n_tokens = B * T
     xf = x.reshape(n_tokens, D)
-    slot, _, token_for_slot = moe_dispatch_plan(sel, E, capacity)
+    slot, _, token_for_slot = plan if plan is not None \
+        else moe_dispatch_plan(sel, E, capacity)
     xf_pad = jnp.concatenate([xf, jnp.zeros((1, D), xf.dtype)])
     expert_in = xf_pad[token_for_slot].reshape(E, capacity, D)
     y = moe_expert_mlp(expert_in, w_in, b_in, w_out, b_out)
@@ -282,10 +291,7 @@ class TransformerLM(nn.Module):
         return self.head_apply(x)
 
 
-def routing_fractions(module: TransformerLM, params, tokens):
-    """Per-layer expert routing fractions f_e for a batch — the
-    collapse-detection metric the Switch aux loss optimizes. Returns
-    ``{block_name: [E] array}`` (empty for dense models)."""
+def _collect_moe_intermediate(module, params, tokens, key: str):
     _, inter = module.apply({"params": params}, tokens,
                             mutable=["intermediates"])
     out = {}
@@ -293,11 +299,28 @@ def routing_fractions(module: TransformerLM, params, tokens):
         inter.get("intermediates", {}))[0]
     for path, leaf in flat:
         names = [getattr(p, "key", str(p)) for p in path]
-        if "expert_fraction" in names:
+        if key in names:
             block = next((n for n in names if n.startswith("block_")),
                          ".".join(names))
             out[block] = leaf
     return out
+
+
+def routing_fractions(module: TransformerLM, params, tokens):
+    """Per-layer expert routing fractions f_e for a batch — the
+    collapse-detection metric the Switch aux loss optimizes. Returns
+    ``{block_name: [E] array}`` (empty for dense models)."""
+    return _collect_moe_intermediate(module, params, tokens,
+                                     "expert_fraction")
+
+
+def drop_fractions(module: TransformerLM, params, tokens):
+    """Per-layer fraction of tokens dropped by the capacity bound
+    (sparse dispatch only) — the observability knob for tuning
+    ``capacity_factor``. Returns ``{block_name: scalar}`` (empty for
+    dense models or exact dispatch)."""
+    return _collect_moe_intermediate(module, params, tokens,
+                                     "drop_fraction")
 
 
 def long_context_apply(module: TransformerLM, params, tokens, mesh,

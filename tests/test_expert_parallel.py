@@ -143,6 +143,27 @@ class TestAuxLoss:
             assert f.shape == (4,)
             np.testing.assert_allclose(float(f.sum()), 1.0, atol=1e-5)
 
+    def test_drop_fractions_metric(self):
+        """drop_fractions: 0 at ample capacity, >0 at a tight one."""
+        from fedtorch_tpu.models.transformer import drop_fractions
+        toks = jax.random.randint(jax.random.key(1), (2, 16), 0, 32)
+        ample = TransformerLM(vocab_size=32, d_model=16, num_heads=2,
+                              num_layers=1, max_len=16, num_experts=4,
+                              capacity_factor=4.0)
+        params = ample.init(jax.random.key(0), toks)["params"]
+        df = drop_fractions(ample, params, toks)
+        assert set(df) == {"block_0"}
+        assert float(df["block_0"]) == 0.0
+        tight = TransformerLM(vocab_size=32, d_model=16, num_heads=2,
+                              num_layers=1, max_len=16, num_experts=4,
+                              capacity_factor=0.25)
+        df = drop_fractions(tight, params, toks)
+        assert float(df["block_0"]) > 0.0
+        # exact dense dispatch sows no drop stat
+        dense = TransformerLM(vocab_size=32, d_model=16, num_heads=2,
+                              num_layers=1, max_len=16, num_experts=4)
+        assert drop_fractions(dense, params, toks) == {}
+
     def test_dense_models_sow_nothing(self):
         model = TransformerLM(vocab_size=32, d_model=16, num_heads=2,
                               num_layers=1, max_len=16)
