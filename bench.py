@@ -349,9 +349,15 @@ def _load_fresh_capture(cpu_steps_per_sec: float):
             return None
         head = _git_head()
         cap_rev = stamp.get("git_head", "unknown")
+        if cap_rev == "unknown" or head == "unknown":
+            # refuse-on-doubt: without both revisions the ancestry of
+            # the capture cannot be established
+            log("persisted TPU capture revision unverifiable "
+                f"(capture={cap_rev[:12]}, head={head[:12]}); using "
+                "the CPU record")
+            return None
         drift = ""
-        if cap_rev != head and cap_rev != "unknown" \
-                and head != "unknown":
+        if cap_rev != head:
             # the capture must come from an ancestor of THIS build
             # (mid-round commits advance HEAD past the capture point);
             # a diverged/foreign revision is refused outright
@@ -369,6 +375,12 @@ def _load_fresh_capture(cpu_steps_per_sec: float):
                   ("metric", "value", "unit", "vs_baseline")}
         if "mfu_pct" in stamp:
             cached["mfu_pct"] = stamp["mfu_pct"]
+        # Machine-readable provenance: automated consumers must be able
+        # to tell a replayed capture from a live measurement without
+        # parsing prose (ADVICE r3).
+        cached["cached"] = True
+        cached["captured_at"] = stamp.get("captured_at")
+        cached["git_head"] = cap_rev
         cached["notes"] = (
             f"{stamp.get('notes', '')}; value is the live TPU capture "
             f"from {stamp.get('captured_at')} on {stamp.get('device')} "

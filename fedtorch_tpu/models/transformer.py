@@ -236,6 +236,16 @@ class _Block(nn.Module):
         return x
 
 
+def block_class(remat: bool):
+    """The block class for one `remat` setting — the SINGLE source of
+    the rematerialization wrapping convention. Both TransformerLM.setup
+    and the pipeline-parallel stage body (parallel/pipeline.py) build
+    blocks through here, so the wrapping (checkpoint policy,
+    static_argnums — attn_override at call arg 2 counting self is a
+    static callable) can never drift between the two."""
+    return nn.remat(_Block, static_argnums=(2,)) if remat else _Block
+
+
 class TransformerLM(nn.Module):
     vocab_size: int = 86
     d_model: int = 128
@@ -257,9 +267,7 @@ class TransformerLM(nn.Module):
         self.pos_embed = self.param("pos_embed",
                                     nn.initializers.normal(0.02),
                                     (self.max_len, self.d_model))
-        # attn_override (call arg 2 counting self) is a static callable
-        block_cls = nn.remat(_Block, static_argnums=(2,)) if self.remat \
-            else _Block
+        block_cls = block_class(self.remat)
         self.blocks = [
             block_cls(self.num_heads, dtype=self.dtype,
                       num_experts=self.num_experts,

@@ -298,6 +298,17 @@ def _prep(q, k, v, scale, block_q, block_k, force):
     """Shared wrapper plumbing: [B,T,H,D] -> [BH,T,D] layout, divisor
     block sizes, backend selection."""
     B, T, H, D = q.shape
+    if k.shape != q.shape or v.shape != q.shape:
+        # The kernel grid and chunked VJP tile Q and K/V with one shared
+        # T; unequal q/kv lengths (e.g. cross-attention or uneven K/V
+        # partitions) are not supported — fail with the shapes rather
+        # than an opaque reshape error downstream. Ring/Ulysses always
+        # pass equal-size blocks.
+        raise ValueError(
+            "flash attention requires q, k, v of identical shape "
+            f"[B, T, H, D]; got q={q.shape}, k={k.shape}, v={v.shape}. "
+            "For disjoint K/V partitions, run the kernel per equal-size "
+            "block and merge with the returned logsumexp.")
     if scale is None:
         scale = 1.0 / math.sqrt(D)
     block_q = _divisor_block(T, block_q)

@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from fedtorch_tpu.models.transformer import TransformerLM, _Block
+from fedtorch_tpu.models.transformer import TransformerLM, block_class
 
 
 def stack_block_params(params, num_layers: int):
@@ -43,7 +43,9 @@ def _pipeline_local(staged, x_mbs, *, block_mod, axis_name: str,
 
     def apply_stage(x):
         def body(c, block_p):
-            return block_mod.apply({"params": block_p}, c), None
+            # attn_override passed explicitly: the remat'd block class
+            # declares call arg 2 static, so the arg must exist
+            return block_mod.apply({"params": block_p}, c, None), None
 
         out, _ = jax.lax.scan(body, x, my_blocks)
         return out
@@ -112,10 +114,13 @@ def _pipelined_fwd(module: TransformerLM, mesh: Mesh, axis_name: str,
     objects their keys pin) age out of long-lived processes."""
     S = mesh.shape[axis_name]
     L = module.num_layers
-    block_mod = _Block(module.num_heads, dtype=module.dtype,
-                       num_experts=module.num_experts,
-                       capacity_factor=module.capacity_factor,
-                       attention=module.attention)
+    # a remat=True model keeps per-block rematerialization under PP too;
+    # block_class is the single source of the wrapping convention
+    block_mod = block_class(module.remat)(
+        module.num_heads, dtype=module.dtype,
+        num_experts=module.num_experts,
+        capacity_factor=module.capacity_factor,
+        attention=module.attention)
     local = functools.partial(
         _pipeline_local, block_mod=block_mod, axis_name=axis_name,
         num_stages=S, num_microbatches=M)

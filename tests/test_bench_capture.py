@@ -1,0 +1,121 @@
+"""Wedged-relay bench reporting (bench.py:_load_fresh_capture).
+
+VERDICT r3 #4: the persisted-capture fallback path failed
+silently-by-absence in rounds 2 and 3 (no capture file ever existed when
+the relay wedged). These tests synthesize capture files and pin every
+branch of the validation — fresh capture reported with machine-readable
+provenance (ADVICE r3: ``cached``/``captured_at``/``git_head``), stale
+captures refused, foreign revisions refused, ancestor revisions accepted
+with drift disclosure, corrupt files never raising.
+"""
+import importlib.util
+import json
+import os
+import subprocess
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def bench(monkeypatch, tmp_path):
+    """Import bench.py as a module with the capture path redirected to a
+    tmp file (never touching a real TPU_BENCH_CAPTURE.json)."""
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.TPU_CAPTURE_PATH = str(tmp_path / "TPU_BENCH_CAPTURE.json")
+    return mod
+
+
+def _head():
+    return subprocess.run(["git", "-C", REPO, "rev-parse", "HEAD"],
+                          capture_output=True, text=True).stdout.strip()
+
+
+def _stamp(bench, **over):
+    rec = {
+        "metric": "fedavg_resnet20_cifar10_100clients_local_steps_per_sec_per_chip",
+        "value": 591.0, "unit": "local-steps/sec/chip",
+        "vs_baseline": 32.47, "mfu_pct": 3.67,
+        "notes": "dispatch=batched-scan",
+        "captured_at": "2026-07-30T00:00:00Z",
+        "captured_unix": int(time.time()) - 3600,
+        "device": "TPU_0(process=0,(0,0,0,0))",
+        "git_head": _head(),
+    }
+    rec.update(over)
+    with open(bench.TPU_CAPTURE_PATH, "w") as f:
+        json.dump(rec, f)
+    return rec
+
+
+class TestFreshCapture:
+    def test_reported_with_machine_readable_provenance(self, bench):
+        stamp = _stamp(bench)
+        out = bench._load_fresh_capture(0.58)
+        assert out is not None
+        # structured fields an automated consumer reads
+        assert out["value"] == stamp["value"]
+        assert out["vs_baseline"] == stamp["vs_baseline"]
+        assert out["mfu_pct"] == stamp["mfu_pct"]
+        # ADVICE r3: provenance must be machine-readable, not prose-only
+        assert out["cached"] is True
+        assert out["captured_at"] == stamp["captured_at"]
+        assert out["git_head"] == stamp["git_head"]
+        # the prose still discloses the substitution
+        assert "relay wedged at report time" in out["notes"]
+
+    def test_unknown_revision_refused(self, bench):
+        """Refuse-on-doubt: a capture whose revision is unrecorded
+        cannot have its ancestry established and must not be replayed
+        (code-review r4 finding)."""
+        _stamp(bench, git_head="unknown")
+        assert bench._load_fresh_capture(0.58) is None
+
+    def test_absent_revision_refused(self, bench):
+        rec = _stamp(bench)
+        del rec["git_head"]
+        with open(bench.TPU_CAPTURE_PATH, "w") as f:
+            json.dump(rec, f)
+        assert bench._load_fresh_capture(0.58) is None
+
+    def test_ancestor_revision_accepted_with_drift_note(self, bench):
+        parent = subprocess.run(
+            ["git", "-C", REPO, "rev-parse", "HEAD~3"],
+            capture_output=True, text=True).stdout.strip()
+        _stamp(bench, git_head=parent)
+        out = bench._load_fresh_capture(0.58)
+        assert out is not None
+        assert out["git_head"] == parent
+        assert "advanced 3 commit(s)" in out["notes"]
+
+
+class TestRefusals:
+    def test_stale_capture_refused(self, bench):
+        _stamp(bench, captured_unix=int(time.time()) - 25 * 3600)
+        assert bench._load_fresh_capture(0.58) is None
+
+    def test_foreign_revision_refused(self, bench):
+        _stamp(bench, git_head="0" * 40)  # not an ancestor of HEAD
+        assert bench._load_fresh_capture(0.58) is None
+
+    def test_missing_file_refused(self, bench):
+        assert bench._load_fresh_capture(0.58) is None
+
+    def test_corrupt_file_never_raises(self, bench):
+        with open(bench.TPU_CAPTURE_PATH, "w") as f:
+            f.write("{not json")
+        assert bench._load_fresh_capture(0.58) is None
+
+    def test_missing_required_key_refused(self, bench):
+        _stamp(bench)
+        with open(bench.TPU_CAPTURE_PATH) as f:
+            rec = json.load(f)
+        del rec["vs_baseline"]
+        with open(bench.TPU_CAPTURE_PATH, "w") as f:
+            json.dump(rec, f)
+        assert bench._load_fresh_capture(0.58) is None

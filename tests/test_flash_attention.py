@@ -84,6 +84,19 @@ class TestBackendSelection:
         with pytest.raises(ValueError, match="force"):
             flash_attention(q, k, v, force="interp")  # typo'd string
 
+    def test_mismatched_kv_length_raises_clearly(self):
+        """kv_len != q_len is unsupported (shared-T tiling); it must
+        fail with the shapes spelled out, not an opaque reshape error
+        (ADVICE r3). Same check on the lse variant."""
+        from fedtorch_tpu.ops.pallas.flash_attention import \
+            flash_attention_with_lse
+        q, _, _ = _qkv(T=64, D=16)
+        k, _, _ = _qkv(T=32, D=16, seed=1)
+        with pytest.raises(ValueError, match="identical shape"):
+            flash_attention(q, k, k, force="xla")
+        with pytest.raises(ValueError, match="identical shape"):
+            flash_attention_with_lse(q, k, k, force="xla")
+
     def test_degenerate_block_falls_back_to_xla(self, monkeypatch):
         """A prime-ish T collapses the divisor blocks to ~T; on TPU the
         [T, T] score tile would blow VMEM, so _prep must route the call

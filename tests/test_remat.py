@@ -114,8 +114,11 @@ class TestTransformerRemat:
         assert _tree_max_err(ga, gb) < 1e-5
 
     def test_pipeline_params_compatible(self):
-        """A remat'd model's params still stack/pipeline (the pipeline
-        body applies plain _Block to the identical tree)."""
+        """A remat'd model's params still stack/pipeline, and the
+        pipeline honors remat: the stage body wraps _Block in nn.remat
+        exactly as TransformerLM.setup does (ADVICE r3), so activation
+        memory under PP matches the flag's promise and outputs are
+        unchanged."""
         import numpy as np
         from jax.sharding import Mesh
         from fedtorch_tpu.parallel.pipeline import pipeline_apply
