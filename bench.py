@@ -371,15 +371,18 @@ def _load_fresh_capture(cpu_steps_per_sec: float):
                            f"{cap_rev}..{head}") or "?"
             drift = (f"; code has advanced {n_ahead} commit(s) since "
                      "the capture")
+        # captured_at is required like the metric fields: provenance
+        # with a null timestamp is not usable provenance (a missing key
+        # falls into the refuse path via KeyError)
         cached = {k: stamp[k] for k in
-                  ("metric", "value", "unit", "vs_baseline")}
+                  ("metric", "value", "unit", "vs_baseline",
+                   "captured_at")}
         if "mfu_pct" in stamp:
             cached["mfu_pct"] = stamp["mfu_pct"]
         # Machine-readable provenance: automated consumers must be able
         # to tell a replayed capture from a live measurement without
         # parsing prose (ADVICE r3).
         cached["cached"] = True
-        cached["captured_at"] = stamp.get("captured_at")
         cached["git_head"] = cap_rev
         cached["notes"] = (
             f"{stamp.get('notes', '')}; value is the live TPU capture "

@@ -1,0 +1,190 @@
+"""MFU sweep on the north-star workload (VERDICT r3 #2).
+
+Round 2 measured 3.67% MFU on the north-star config (FedAvg, ResNet-20,
+100 clients, batch 50, k=10 online, bf16) and hypothesized an
+MXU-underfill regime (32x32 convs, small per-client batches, grouped
+convs from per-client weights) without measuring any lever. This script
+measures the levers: it times the REAL federated trainer
+(`FederatedTrainer.run_rounds`, the same program `bench.py` times) under
+a grid of configurations and reports local-steps/sec/chip + analytic
+MFU for each:
+
+  base        B=50  bf16 unroll=1 k=10   (the north-star itself)
+  batch128    B=128 — 2.56x more rows per conv call
+  batch256    B=256 — 5.12x
+  f32         B=50 float32 — is bf16 actually buying anything?
+  unroll4     B=50 unroll=4 — XLA software-pipelining across local steps
+  batch128u4  B=128 unroll=4 — the two levers combined
+  online20    B=50 k=20 — more clients in flight per round
+
+MFU accounting: resnet20-cifar fwd = 40.8e6 MACs/image, train step =
+3x fwd, 2 FLOPs/MAC (identical to bench.py; per-image work is batch-
+size-invariant so configs are directly comparable). Peak via
+BENCH_PEAK_TFLOPS (default 197 bf16 / 98 f32, TPU v5e).
+
+``MFU_PROFILE=1`` additionally captures a jax.profiler trace of the
+base config's timed segment to artifacts/trace_northstar/ for the
+roofline note.
+
+Writes MFU_SWEEP.json; prints one JSON line. Relay-gated (real chip
+only — CPU numbers would answer nothing about the MXU).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+# env-overridable for CPU smoke tests of the plumbing (the measured
+# grid always runs the real sizes)
+NUM_CLIENTS = int(os.environ.get("MFU_CLIENTS", "100"))
+LOCAL_STEPS = int(os.environ.get("MFU_STEPS", "10"))
+TIMED_ROUNDS = int(os.environ.get("MFU_ROUNDS", "5"))
+TRAIN_FLOPS_PER_IMAGE = 3 * 2 * 40.8e6  # bench.py's accounting
+
+
+def run_config(name, *, batch, dtype="bfloat16", unroll=1,
+               online_rate=0.1, profile_dir=None):
+    import jax
+    from fedtorch_tpu.algorithms import make_algorithm
+    from fedtorch_tpu.config import (
+        DataConfig, ExperimentConfig, FederatedConfig, MeshConfig,
+        ModelConfig, OptimConfig, TrainConfig,
+    )
+    from fedtorch_tpu.data.batching import stack_partitions
+    from fedtorch_tpu.models import define_model
+    from fedtorch_tpu.parallel import FederatedTrainer
+
+    cfg = ExperimentConfig(
+        data=DataConfig(dataset="cifar10", batch_size=batch),
+        federated=FederatedConfig(
+            federated=True, num_clients=NUM_CLIENTS,
+            online_client_rate=online_rate, algorithm="fedavg",
+            sync_type="local_step"),
+        model=ModelConfig(arch="resnet20"),
+        optim=OptimConfig(lr=0.1, in_momentum=True),
+        train=TrainConfig(local_step=LOCAL_STEPS),
+        mesh=MeshConfig(compute_dtype=dtype, scan_unroll=unroll),
+    ).finalize()
+
+    samples = max(250, batch)  # each client must cover one full batch
+    rng = np.random.RandomState(0)
+    feats = rng.randn(NUM_CLIENTS * samples, 32, 32, 3).astype(
+        np.float32)
+    labels = rng.randint(0, 10, NUM_CLIENTS * samples)
+    parts = [np.arange(i * samples, (i + 1) * samples)
+             for i in range(NUM_CLIENTS)]
+    data = stack_partitions(feats, labels, parts)
+
+    model = define_model(cfg, batch_size=batch)
+    trainer = FederatedTrainer(cfg, model, make_algorithm(cfg), data)
+    server, clients = trainer.init_state(jax.random.key(0))
+
+    t0 = time.time()
+    server, clients, _ = trainer.run_rounds(server, clients,
+                                            TIMED_ROUNDS)
+    jax.block_until_ready(server.params)
+    compile_s = time.time() - t0
+
+    if profile_dir:
+        os.makedirs(profile_dir, exist_ok=True)
+        jax.profiler.start_trace(profile_dir)
+    t0 = time.time()
+    server, clients, _ = trainer.run_rounds(server, clients,
+                                            TIMED_ROUNDS)
+    jax.block_until_ready(server.params)
+    dt = time.time() - t0
+    if profile_dir:
+        jax.profiler.stop_trace()
+        log(f"profiler trace captured to {profile_dir}")
+
+    n_chips = int(trainer.mesh.devices.size)
+    steps = TIMED_ROUNDS * trainer.k_online * trainer.local_steps
+    steps_per_sec = steps / dt / n_chips
+    peak_tflops = float(os.environ.get(
+        "BENCH_PEAK_TFLOPS",
+        "197" if dtype == "bfloat16" else "98"))
+    achieved = steps_per_sec * batch * TRAIN_FLOPS_PER_IMAGE
+    mfu_pct = round(100 * achieved / (peak_tflops * 1e12), 2)
+    row = {
+        "batch": batch, "dtype": dtype, "scan_unroll": unroll,
+        "k_online": int(trainer.k_online),
+        "local_steps_per_sec_per_chip": round(steps_per_sec, 2),
+        "images_per_sec": round(steps_per_sec * batch, 1),
+        "achieved_tflops": round(achieved / 1e12, 3),
+        "peak_tflops": peak_tflops,
+        "mfu_pct": mfu_pct,
+        "compile_plus_first_s": round(compile_s, 1),
+        "timed_s": round(dt, 2),
+    }
+    log(f"{name:12s}: {steps_per_sec:8.2f} steps/s/chip  "
+        f"{row['images_per_sec']:9.1f} img/s  MFU {mfu_pct:5.2f}%  "
+        f"(compile+1st {compile_s:.0f}s)")
+    return row
+
+
+def main():
+    from bench import probe_device
+    if not probe_device():
+        log("TPU relay unavailable — MFU is only meaningful on the "
+            "chip; nothing recorded")
+        return 1
+    import jax
+    from fedtorch_tpu.utils import enable_compile_cache
+    enable_compile_cache()
+    dev = jax.devices()[0]
+    log(f"device: {dev}")
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    profile_dir = os.path.join(repo, "artifacts", "trace_northstar") \
+        if os.environ.get("MFU_PROFILE") == "1" else None
+
+    grid = [
+        ("base", dict(batch=50, profile_dir=profile_dir)),
+        ("batch128", dict(batch=128)),
+        ("batch256", dict(batch=256)),
+        ("f32", dict(batch=50, dtype="float32")),
+        ("unroll4", dict(batch=50, unroll=4)),
+        ("batch128u4", dict(batch=128, unroll=4)),
+        ("online20", dict(batch=50, online_rate=0.2)),
+    ]
+    results = {"platform": str(dev),
+               "flops_accounting":
+                   "3x fwd, 2 FLOPs/MAC, 40.8e6 MACs/img (bench.py)",
+               "configs": {}}
+    best = None
+    for name, kw in grid:
+        try:
+            row = run_config(name, **kw)
+            results["configs"][name] = row
+            if best is None or row["mfu_pct"] > best[1]:
+                best = (name, row["mfu_pct"])
+        except Exception as e:  # an OOM at B=256 is itself a datum
+            results["configs"][name] = {"error": str(e)[:300]}
+            log(f"{name}: FAIL {str(e)[:160]}")
+        # persist incrementally — a relay wedge mid-sweep must not lose
+        # the configs already measured
+        with open(os.path.join(repo, "MFU_SWEEP.json"), "w") as f:
+            json.dump(results, f, indent=1)
+
+    print(json.dumps({
+        "mfu_sweep_ok": best is not None,
+        "best_config": best[0] if best else None,
+        "best_mfu_pct": best[1] if best else None,
+        "platform": str(dev)}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

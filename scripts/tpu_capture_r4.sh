@@ -1,0 +1,42 @@
+#!/bin/bash
+# Round-4 second-stage on-chip captures: the MFU sweep (VERDICT r3 #2)
+# and the sparse-MoE dispatch A/B (VERDICT r3 #6). Chained behind
+# tpu_capture_full.sh — waits for it to exit first (single-session
+# relay + 1-core host: strictly serial), then captures with its own
+# relay patience (covers the case where stage 1 exhausted its probes
+# and the relay recovers later in the round).
+#     nohup bash scripts/tpu_capture_r4.sh > /tmp/tpu_capture_r4.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.." || exit 1
+
+while pgrep -f "bash scripts/tpu_capture_full.sh" > /dev/null; do
+    sleep 60
+done
+echo "[tpu_capture_r4] stage 1 done (or not running) — starting stage 2"
+
+TRIES="${TPU_CAPTURE_WAIT_TRIES:-85}"   # ~5.7 h of patience
+BENCH_PROBE_TRIES="$TRIES" python - <<'EOF'
+import sys
+sys.path.insert(0, ".")
+from bench import probe_device
+sys.exit(0 if probe_device() else 1)
+EOF
+if [ $? -ne 0 ]; then
+    echo "[tpu_capture_r4] relay never recovered; nothing captured"
+    exit 1
+fi
+
+echo "[tpu_capture_r4] relay alive — capturing (sequential)"
+FAILED=0
+run() {
+    echo "=== $* ==="
+    BENCH_PROBE_TRIES=2 "$@"
+    local rc=$?
+    echo "=== rc=$rc ==="
+    [ $rc -ne 0 ] && FAILED=1
+}
+
+run env MFU_PROFILE=1 python scripts/mfu_sweep.py   # -> MFU_SWEEP.json
+run python scripts/moe_ab_bench.py                  # -> MOE_AB.json
+echo "[tpu_capture_r4] done (failed=$FAILED)"
+exit $FAILED
