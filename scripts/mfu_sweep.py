@@ -27,7 +27,17 @@ base config's timed segment to artifacts/trace_northstar/ for the
 roofline note.
 
 Writes MFU_SWEEP.json; prints one JSON line. Relay-gated (real chip
-only — CPU numbers would answer nothing about the MXU).
+only — CPU numbers would answer nothing about the MXU; main() refuses
+to record if the backend resolves to CPU). To smoke-test the plumbing
+off-chip, do NOT run main() (its probe opens a relay session): import
+``run_config`` directly under a cpu-forced interpreter, e.g.
+
+    JAX_PLATFORMS=cpu MFU_CLIENTS=8 MFU_STEPS=2 MFU_ROUNDS=1 python -c "
+    import sys; sys.path[:0] = ['scripts', '.']
+    from fedtorch_tpu.utils import honor_platform_env
+    honor_platform_env()
+    from mfu_sweep import run_config
+    print(run_config('smoke', batch=8, online_rate=0.25))"
 """
 from __future__ import annotations
 
@@ -145,6 +155,13 @@ def main():
     enable_compile_cache()
     dev = jax.devices()[0]
     log(f"device: {dev}")
+    if dev.platform == "cpu":
+        # a fast relay-init failure can fall back to the cpu platform
+        # with the probe still exiting 0 — CPU timings divided by a TPU
+        # peak would be garbage MFU presented as an on-chip number
+        log("backend resolved to CPU despite a passing probe — refusing "
+            "to record MFU (tpu_zoo_check.py guard)")
+        return 1
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     profile_dir = os.path.join(repo, "artifacts", "trace_northstar") \

@@ -18,7 +18,12 @@ short same-seed loss trajectory (sparse must track dense closely while
 costing a fraction of its step time — that is the case for flipping the
 recommended large-E training config to sparse).
 
-Writes MOE_AB.json; prints one JSON line. Relay-gated.
+Writes MOE_AB.json; prints one JSON line. Relay-gated (main() refuses
+to record if the backend resolves to CPU). To smoke-test the plumbing
+off-chip, do NOT run main() (its probe opens a relay session): import
+``run_case`` directly under a cpu-forced interpreter (set
+JAX_PLATFORMS=cpu, call fedtorch_tpu.utils.honor_platform_env() first,
+then run_case("dense", 0.0) with the MOE_AB_* size overrides).
 """
 from __future__ import annotations
 
@@ -88,16 +93,20 @@ def run_case(name, capacity_factor):
     jax.block_until_ready(ce)
     compile_s = time.time() - t0
 
+    # keep device arrays (no host sync inside the timed loop) so the
+    # loss trajectory starts at step 1, not after the warmup steps
+    loss_dev = [ce]
     t0 = time.time()
     for _ in range(ITERS):
         params, state, ce = train_step(params, state)
+        loss_dev.append(ce)
     jax.block_until_ready(ce)
     step_ms = (time.time() - t0) / ITERS * 1e3
 
-    losses = [float(ce)]
     for _ in range(LOSS_STEPS - ITERS - 1):
         params, state, ce = train_step(params, state)
-        losses.append(float(ce))
+        loss_dev.append(ce)
+    losses = [float(x) for x in loss_dev]
 
     drops = drop_fractions(model, params, toks)
     drop = {k: round(float(v), 4) for k, v in drops.items()}
@@ -126,6 +135,12 @@ def main():
     enable_compile_cache()
     dev = jax.devices()[0]
     log(f"device: {dev}")
+    if dev.platform == "cpu":
+        # fast relay-init failure -> silent cpu fallback; a CPU step
+        # time labeled as the dispatch cost would mislead the A/B
+        log("backend resolved to CPU despite a passing probe — refusing "
+            "to record the A/B")
+        return 1
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     results = {"platform": str(dev),

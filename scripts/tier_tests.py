@@ -1,0 +1,50 @@
+"""Regenerate tests/slow_tests.txt from a pytest --durations=0 log.
+
+The suite is tiered (VERDICT r3 #5): tests whose measured call time is
+>= THRESHOLD seconds on the 1-core reference box are auto-marked
+``slow`` by the conftest hook, giving CI a fast default lane
+(``pytest -m "not slow"``) while ``pytest tests/`` still runs
+everything. Regenerate after a significant suite change:
+
+    python -m pytest tests/ -q --durations=0 > /tmp/durations.log
+    python scripts/tier_tests.py /tmp/durations.log
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+THRESHOLD_S = 3.1
+
+_LINE = re.compile(r"^(\d+\.\d+)s call\s+(\S+)")
+
+
+def main(log_path: str) -> int:
+    rows = []
+    with open(log_path) as f:
+        for line in f:
+            m = _LINE.match(line.strip())
+            if m and float(m.group(1)) >= THRESHOLD_S:
+                rows.append((float(m.group(1)), m.group(2)))
+    if not rows:
+        print("no slow tests found — is this a --durations=0 log?",
+              file=sys.stderr)
+        return 1
+    rows.sort(reverse=True)
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tests", "slow_tests.txt")
+    with open(out, "w") as f:
+        f.write(
+            "# Auto-marked `slow` by tests/conftest.py (nodeids whose\n"
+            f"# measured call time was >= {THRESHOLD_S}s on the 1-core\n"
+            "# reference box). Regenerate: see scripts/tier_tests.py.\n")
+        for dur, nodeid in rows:
+            f.write(f"{nodeid}  # {dur:.1f}s\n")
+    print(f"wrote {out}: {len(rows)} slow tests "
+          f"(sum {sum(d for d, _ in rows):.0f}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1]))

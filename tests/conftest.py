@@ -23,3 +23,48 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+# -- Suite tiering ----------------------------------------------------------
+# tests/slow_tests.txt lists nodeids measured >= the threshold on the
+# 1-core reference box (scripts/tier_tests.py regenerates it from a
+# --durations=0 log). They get the `slow` marker automatically, so
+#   pytest -m "not slow"    is the fast lane (<5 min on that box)
+#   pytest tests/           still runs everything.
+# Explicit @pytest.mark.slow decorations (multi-process tests) remain.
+_SLOW_LIST = os.path.join(os.path.dirname(__file__), "slow_tests.txt")
+
+
+def _slow_nodeids():
+    try:
+        with open(_SLOW_LIST) as f:
+            return {line.split("#", 1)[0].strip() for line in f
+                    if line.strip() and not line.startswith("#")}
+    except OSError:
+        return set()
+
+
+def pytest_collection_modifyitems(config, items):
+    import warnings
+    slow = _slow_nodeids()
+    if not slow:
+        warnings.warn("tests/slow_tests.txt missing or empty — the "
+                      "fast lane (-m 'not slow') will run slow tests; "
+                      "regenerate with scripts/tier_tests.py")
+        return
+    matched = set()
+    for item in items:
+        if item.nodeid in slow:
+            matched.add(item.nodeid)
+            item.add_marker(pytest.mark.slow)
+    # surface staleness: a renamed test or changed parametrize id would
+    # otherwise silently re-enter the fast lane (a partial collection
+    # run legitimately matches only a subset, so only warn when the
+    # whole suite was collected)
+    unmatched = slow - matched
+    if unmatched and len(items) > len(slow):
+        warnings.warn(f"{len(unmatched)} entries in tests/slow_tests.txt "
+                      "match no collected test (stale after a rename?); "
+                      "regenerate with scripts/tier_tests.py: "
+                      + ", ".join(sorted(unmatched)[:3]) + " ...")

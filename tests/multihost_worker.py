@@ -3,55 +3,23 @@
 Each of the two processes owns 4 virtual CPU devices; together they form
 an 8-device global mesh over which one federated round executes — the
 DCN analog of the reference's ``dist.init_process_group('mpi')`` bring-up
-(main.py:17). Run as:
+(main.py:17). Bring-up shared with the 4-process interrupt-resume
+scenario via mh_common.py. Run as:
 
-    python tests/multihost_worker.py <port> <process_id>
+    python tests/multihost_worker.py <port> <process_id> [ckpt_dir]
 """
 import os
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from mh_common import bringup, configure_env  # noqa: E402
+
 port, pid = sys.argv[1], int(sys.argv[2])
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # keep sitecustomize off TPU
+configure_env(local_devices=4)  # before the first jax import
 
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-
-from fedtorch_tpu.algorithms import make_algorithm  # noqa: E402
-from fedtorch_tpu.config import (  # noqa: E402
-    DataConfig, ExperimentConfig, FederatedConfig, MeshConfig, ModelConfig,
-    OptimConfig, TrainConfig,
-)
-from fedtorch_tpu.data import build_federated_data  # noqa: E402
-from fedtorch_tpu.models import define_model  # noqa: E402
-from fedtorch_tpu.parallel import FederatedTrainer, init_multihost  # noqa: E402
-
-mesh_cfg = MeshConfig(coordinator_address=f"localhost:{port}",
-                      num_processes=2, process_id=pid)
-init_multihost(mesh_cfg)
-assert jax.process_count() == 2, jax.process_count()
+jax, cfg, trainer = bringup(port, pid, num_processes=2,
+                            local_devices=4, online_client_rate=1.0)
 assert len(jax.devices()) == 8, jax.devices()
-assert len(jax.local_devices()) == 4
-
-cfg = ExperimentConfig(
-    data=DataConfig(dataset="synthetic", synthetic_dim=12, batch_size=8),
-    federated=FederatedConfig(federated=True, num_clients=10,
-                              online_client_rate=1.0, algorithm="fedavg",
-                              sync_type="local_step"),
-    model=ModelConfig(arch="logistic_regression"),
-    optim=OptimConfig(lr=0.1, weight_decay=0.0),
-    train=TrainConfig(local_step=2),
-    mesh=mesh_cfg,
-).finalize()
-# every process derives identical data/partitions from the shared seed —
-# the determinism contract that replaces the reference's rank-0 broadcast
-# (partition.py:25-33; docs/multihost.md 'Determinism across hosts')
-data = build_federated_data(cfg)
-model = define_model(cfg, batch_size=cfg.data.batch_size)
-trainer = FederatedTrainer(cfg, model, make_algorithm(cfg), data.train)
-assert trainer.mesh.devices.size == 8
 assert trainer.padded_clients == 16  # 10 clients padded over 8 devices
 
 server, clients = trainer.init_state(jax.random.key(0))

@@ -1,0 +1,135 @@
+"""Readers validated against FORMAT-FAITHFUL fixtures (VERDICT r3 #7).
+
+tests/test_dataset_readers.py proves the readers parse minimal
+structurally-correct files; this module tightens that to fixtures
+reproducing the real distributions' quirks (tests/format_fixtures.py
+documents each quirk with its public-spec source): TFF writer-id naming
+and inverted-background float pixels, multi-snippet Shakespeare clients
+with out-of-vocab characters, svmlight sparsity gaps / comments / bz2
+compression / MSD regression years.
+"""
+import numpy as np
+import pytest
+
+from fedtorch_tpu.data.datasets import (
+    load_emnist, load_libsvm, load_shakespeare, shakespeare_vocab,
+)
+from format_fixtures import (  # tests/ is on sys.path under pytest
+    emnist_writer_id, write_svmlight, write_tff_emnist,
+    write_tff_shakespeare,
+)
+
+
+class TestTFFEmnist:
+    def test_faithful_file_roundtrip(self, tmp_path):
+        h5py = pytest.importorskip("h5py")
+        clients = {emnist_writer_id(i): n
+                   for i, n in zip(range(4), (7, 3, 5, 2))}
+        p = tmp_path / "emnist" / "fed_emnist_digitsonly_train.h5"
+        write_tff_emnist(str(p), clients, label_dtype=np.int32)
+        splits = load_emnist(str(tmp_path), full=False)
+        assert splits.train_x.shape == (17, 28, 28, 1)
+        # int32 labels (the real files' dtype) widen to int64
+        assert splits.train_y.dtype == np.int64
+        # inverted-background convention survives: background is 1.0
+        assert float(np.median(splits.train_x)) == 1.0
+        # one natural partition per writer, in sorted-id order, sizes
+        # matching each writer's example count
+        assert len(splits.client_partitions) == 4
+        sizes = {cid: n for cid, n in clients.items()}
+        for cid, part in zip(sorted(clients), splits.client_partitions):
+            assert len(part) == sizes[cid]
+        # byte-exact: reading the file back gives the written pixels
+        with h5py.File(p, "r") as f:
+            first = sorted(clients)[0]
+            px = np.asarray(f["examples"][first]["pixels"])
+        np.testing.assert_array_equal(
+            splits.train_x[splits.client_partitions[0], ..., 0], px)
+
+    def test_full_split_layout(self, tmp_path):
+        pytest.importorskip("h5py")
+        p = tmp_path / "emnist_full" / "fed_emnist_train.h5"
+        write_tff_emnist(str(p), {emnist_writer_id(0): 4})
+        splits = load_emnist(str(tmp_path), full=True)
+        assert splits.train_x.shape == (4, 28, 28, 1)
+
+
+class TestTFFShakespeare:
+    def test_multi_snippet_clients_with_oov(self, tmp_path):
+        pytest.importorskip("h5py")
+        vocab = shakespeare_vocab()
+        # real files: several variable-length snippets per client;
+        # include chars outside the 86-char vocabulary (e.g. 'æ', '—')
+        clients = {
+            "THE_TRAGEDY_OF_HAMLET_HAMLET": [
+                "To be, or not to be: that is the question:\n",
+                "Whether 'tis nobler in the mind to suffer\n",
+                "the slings and arrows of outrageous fortune,",
+            ],
+            "ALLS_WELL_THAT_ENDS_WELL_HELENA": [
+                "Our remedies oft in ourselves do lie — with æther!",
+            ],
+        }
+        p = tmp_path / "shakespeare" / "shakespeare_train.h5"
+        write_tff_shakespeare(str(p), clients)
+        splits = load_shakespeare(str(tmp_path), seq_len=16)
+        assert splits.train_x.shape[1] == 16
+        # both clients produced at least one window
+        assert len(splits.client_partitions) == 2
+        # windows tile the CONCATENATION of a client's snippets: client
+        # 1 (sorted first: ALLS_WELL...) has 50 chars -> 3 windows of 16
+        text1 = "".join(clients["ALLS_WELL_THAT_ENDS_WELL_HELENA"])
+        assert len(splits.client_partitions[0]) == (len(text1) - 1) // 16
+        # out-of-vocab characters map to index 0, never crash
+        ids = np.asarray(splits.train_x)
+        assert ids.max() < len(vocab)
+        # next-char shift property holds across snippet joins
+        np.testing.assert_array_equal(ids[0, 1:],
+                                      np.asarray(splits.train_y)[0, :-1])
+
+
+class TestSvmlight:
+    def test_sparse_gaps_reconstruct_dense(self, tmp_path):
+        """Gapped ascending 1-based indices with implicit zeros parse to
+        exactly the dense matrix the generator materialized."""
+        dense, ys = write_svmlight(
+            str(tmp_path / "higgs" / "HIGGS"), 1100, 8, labels="01",
+            comments=True)
+        splits = load_libsvm("higgs", str(tmp_path))
+        got = np.concatenate([splits.train_x, splits.test_x])
+        np.testing.assert_allclose(got, dense, rtol=1e-5, atol=1e-8)
+        got_y = np.concatenate([splits.train_y, splits.test_y])
+        np.testing.assert_array_equal(got_y, (ys > 0).astype(np.int64))
+
+    def test_bz2_compressed_as_distributed(self, tmp_path):
+        """rcv1 ships bz2-compressed with {-1,+1} labels; the reader
+        must find the .bz2, decompress, and map labels to {0,1}."""
+        dense, ys = write_svmlight(
+            str(tmp_path / "rcv1" / "rcv1_train.binary.bz2"), 30, 6,
+            labels="pm1", compress=True)
+        write_svmlight(
+            str(tmp_path / "rcv1" / "rcv1_test.binary.bz2"), 10, 6,
+            labels="pm1", compress=True, seed=1)
+        splits = load_libsvm("rcv1", str(tmp_path))
+        assert splits.train_x.shape == (30, 6)
+        np.testing.assert_allclose(splits.train_x, dense, rtol=1e-5,
+                                   atol=1e-8)
+        np.testing.assert_array_equal(
+            splits.train_y, (ys > 0).astype(np.int64))
+
+    def test_msd_regression_years_standardized(self, tmp_path):
+        """MSD is regression on years: labels stay float years, features
+        are standardized train-statistics-only."""
+        write_svmlight(str(tmp_path / "MSD" / "YearPredictionMSD"),
+                       60, 5, labels="year")
+        write_svmlight(str(tmp_path / "MSD" / "YearPredictionMSD.t"),
+                       20, 5, labels="year", seed=1)
+        splits = load_libsvm("MSD", str(tmp_path))
+        assert splits.train_y.dtype == np.float32
+        assert splits.train_y.min() >= 1922
+        assert splits.train_y.max() <= 2011
+        # standardized with train stats: mean ~0, std ~1 on train
+        np.testing.assert_allclose(splits.train_x.mean(0),
+                                   np.zeros(5), atol=1e-4)
+        np.testing.assert_allclose(splits.train_x.std(0),
+                                   np.ones(5), atol=1e-2)

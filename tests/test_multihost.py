@@ -12,50 +12,21 @@ cross-host divergence is a determinism bug.
 """
 import os
 import re
-import socket
-import subprocess
 import sys
 
 import pytest
 
-
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("localhost", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from mh_common import run_workers  # noqa: E402
 
 
 @pytest.mark.slow
 def test_two_process_round(tmp_path):
-    port = _free_port()
     script = os.path.join(os.path.dirname(__file__),
                           "multihost_worker.py")
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)  # no TPU relay in workers
-    env["PYTHONPATH"] = os.pathsep.join(
-        [os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-         env.get("PYTHONPATH", "")])
     ckpt_dir = str(tmp_path / "mh_ckpt")
-    procs = [
-        subprocess.Popen(
-            [sys.executable, script, str(port), str(pid), ckpt_dir],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-            env=env)
-        for pid in (0, 1)
-    ]
-    outs = []
-    for p in procs:
-        try:
-            out, _ = p.communicate(timeout=420)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            pytest.fail("multihost worker timed out")
-        outs.append(out)
-    for pid, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+    outs = run_workers(script, [ckpt_dir], 2)
+    for out in outs:
         assert "MULTIHOST_OK" in out, out
         # the collective checkpoint snapshot + process-0 write + resume
         # ran on both processes

@@ -1,0 +1,62 @@
+"""4-process DCN grid with a mid-run cross-host checkpoint restore
+(VERDICT r3 #8 — the next rung of the multi-host story while real pods
+are unavailable; replaces the substrate the reference builds with MPI,
+docker/CUDA-MPI/Dockerfile:37-52).
+
+Three waves of 4 coordinated processes (2 virtual CPU devices each →
+8-device global mesh):
+
+  wave A  "full"   — 4 uninterrupted rounds        → fingerprints 1-4
+  wave B  "first"  — rounds 1-2 + collective snapshot  (the "crash")
+  wave C  "resume" — NEW processes restore the checkpoint, rounds 3-4
+                                                   → fingerprints 3-4
+
+Asserts, per round and bit-for-bit (full-precision reprs of loss sum /
+mean epoch / param norm): every process agrees within a wave, and wave
+C's rounds 3-4 equal wave A's — the checkpoint carries full round
+state (params, aux, counters, PRNG), so recovery is exact and
+cross-host.
+"""
+import os
+import re
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from mh_common import run_workers  # noqa: E402
+
+N_PROCS = 4
+_TRAJ = re.compile(r"TRAJ pid=\d+ (round=\d+ .*)$", re.M)
+_WORKER = os.path.join(os.path.dirname(__file__),
+                       "multihost_resume_worker.py")
+
+
+def _trajectories(outs):
+    """Per-process list of per-round fingerprint strings."""
+    return [_TRAJ.findall(out) for out in outs]
+
+
+@pytest.mark.slow
+def test_four_process_interrupt_resume_matches_uninterrupted(tmp_path):
+    ckpt = str(tmp_path / "mh4_ckpt")
+
+    full = _trajectories(run_workers(_WORKER, ["full", ckpt], N_PROCS))
+    assert all(len(t) == 4 for t in full), full
+    # every host reports the identical per-round trajectory
+    # (shared-seed contract)
+    assert all(t == full[0] for t in full[1:]), full
+
+    outs_first = run_workers(_WORKER, ["first", ckpt], N_PROCS)
+    for out in outs_first:
+        assert "CKPT_SAVED" in out, out
+    assert os.path.exists(os.path.join(ckpt, "checkpoint.ckpt"))
+
+    resumed = _trajectories(run_workers(_WORKER, ["resume", ckpt],
+                                        N_PROCS))
+    assert all(len(t) == 2 for t in resumed), resumed
+    assert all(t == resumed[0] for t in resumed[1:]), resumed
+
+    # the interrupted-and-restored rounds 3-4 are bit-identical, round
+    # by round, to the uninterrupted run's rounds 3-4
+    assert resumed[0] == full[0][2:], (full[0], resumed[0])
