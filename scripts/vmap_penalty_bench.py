@@ -15,7 +15,17 @@ identical total work (k*B images):
 
 The gap between `shared` and `vmapped` is the price of federated
 semantics, not implementation slack; `scanned` shows the alternative the
-engine rejected.  Writes VMAP_PENALTY.json.
+engine rejected.
+
+Second section (``conv_lowering``): per-stage micro A/B of HOW the
+per-client conv lowers. vmap-of-conv with a [k] weight axis becomes a
+``batch_group_count=k`` grouped convolution; the alternative
+formulation extracts im2col patches and runs one batched matmul
+``[k, B·P, 9C] x [k, 9C, F]`` — rows/cols the MXU tiles directly. If
+the matmul form wins decisively on fwd+bwd, a model-level opt-in conv
+path is the next MFU lever; if not, the grouped-conv lowering is
+already fine and the MFU ceiling is the channel underfill documented
+in docs/performance.md.  Writes VMAP_PENALTY.json.
 """
 from __future__ import annotations
 
@@ -64,6 +74,72 @@ def timeit(fn, *args):
     return (time.time() - t0) / STEPS
 
 
+def conv_lowering_ab():
+    """Per-resnet20-stage fwd+bwd timing: vmapped conv (grouped-conv
+    lowering) vs im2col + batched matmul (same math, MXU-native
+    shape). Patch extraction is charged to the matmul variant — it is
+    part of that formulation's real cost."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    rng = np.random.RandomState(1)
+    dt = jnp.bfloat16
+    section = {}
+    for cin, cout, hw in ((16, 16, 32), (32, 32, 16), (64, 64, 8)):
+        x = jnp.asarray(rng.randn(K_CLIENTS, BATCH, hw, hw, cin), dt)
+        w = jnp.asarray(rng.randn(K_CLIENTS, 3, 3, cin, cout) * 0.05,
+                        dt)
+
+        def conv_one(xi, wi):
+            return lax.conv_general_dilated(
+                xi, wi, window_strides=(1, 1), padding="SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+        def loss_conv(w_):
+            return jnp.sum(jax.vmap(conv_one)(x, w_) ** 2)
+
+        def loss_matmul(w_):
+            # [k, B, hw, hw, 9*cin] patches; charged to this variant
+            patches = jax.vmap(lambda xi: lax.conv_general_dilated_patches(
+                xi, (3, 3), (1, 1), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC")))(x)
+            p = patches.reshape(K_CLIENTS, BATCH * hw * hw, 9 * cin)
+            # conv_general_dilated_patches orders features as
+            # [cin, 3, 3]; permute the weights to match
+            wm = w_.transpose(0, 3, 1, 2, 4).reshape(
+                K_CLIENTS, cin * 9, cout)
+            return jnp.sum(jnp.einsum("kpc,kcf->kpf", p, wm) ** 2)
+
+        # numerics agreement guard (bf16 tolerance) before timing
+        a = jax.jit(loss_conv)(w)
+        b = jax.jit(loss_matmul)(w)
+        rel = abs(float(a) - float(b)) / max(abs(float(a)), 1e-9)
+        row = {"agree_rel_err": round(rel, 4)}
+        if rel > 0.05:  # bf16 tolerance — ENFORCED, not just recorded
+            row["invalid"] = ("formulations disagree; timing skipped "
+                              "(patch ordering regression?)")
+            print(f"conv_lowering {cin}->{cout}: DISAGREE rel={rel:.3f}"
+                  " — skipping timings", file=sys.stderr)
+            section[f"stage_{cin}x{cout}_{hw}px"] = row
+            continue
+        for name, fn in (("conv_vmap", loss_conv),
+                         ("im2col_matmul", loss_matmul)):
+            g = jax.jit(jax.grad(fn))
+            dtms = timeit(g, w) * 1e3
+            row[f"{name}_fwdbwd_ms"] = round(dtms, 3)
+        row["matmul_speedup_x"] = round(
+            row["conv_vmap_fwdbwd_ms"] / row["im2col_matmul_fwdbwd_ms"],
+            2)
+        section[f"stage_{cin}x{cout}_{hw}px"] = row
+        print(f"conv_lowering {cin}->{cout} @{hw}px: conv "
+              f"{row['conv_vmap_fwdbwd_ms']:.2f} ms vs matmul "
+              f"{row['im2col_matmul_fwdbwd_ms']:.2f} ms "
+              f"(x{row['matmul_speedup_x']}, rel err "
+              f"{row['agree_rel_err']})", file=sys.stderr)
+    return section
+
+
 def main():
     model = build_model()
     rng = np.random.RandomState(0)
@@ -110,6 +186,7 @@ def main():
               "images fwd+bwd", file=sys.stderr)
     out["vmap_penalty_x"] = round(
         out["ms_per_step"]["vmapped"] / out["ms_per_step"]["shared"], 2)
+    out["conv_lowering"] = conv_lowering_ab()
     path = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "VMAP_PENALTY.json")
     with open(path, "w") as f:

@@ -59,11 +59,13 @@ def pytest_collection_modifyitems(config, items):
             matched.add(item.nodeid)
             item.add_marker(pytest.mark.slow)
     # surface staleness: a renamed test or changed parametrize id would
-    # otherwise silently re-enter the fast lane (a partial collection
-    # run legitimately matches only a subset, so only warn when the
-    # whole suite was collected)
-    unmatched = slow - matched
-    if unmatched and len(items) > len(slow):
+    # otherwise silently re-enter the fast lane. Only judge entries
+    # whose FILE was collected in this run, so path-restricted runs
+    # (pytest tests/test_foo.py) never warn spuriously.
+    collected_files = {item.nodeid.split("::", 1)[0] for item in items}
+    unmatched = {s for s in slow - matched
+                 if s.split("::", 1)[0] in collected_files}
+    if unmatched:
         warnings.warn(f"{len(unmatched)} entries in tests/slow_tests.txt "
                       "match no collected test (stale after a rename?); "
                       "regenerate with scripts/tier_tests.py: "
