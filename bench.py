@@ -187,7 +187,11 @@ def main():
             federated=True, num_clients=NUM_CLIENTS,
             online_client_rate=ONLINE_RATE, algorithm="fedavg",
             sync_type="local_step"),
-        model=ModelConfig(arch="resnet20"),
+        # BENCH_CONV_IMPL=matmul A/Bs the im2col conv lowering
+        # (docs/performance.md "MFU roofline")
+        model=ModelConfig(arch="resnet20",
+                          conv_impl=os.environ.get("BENCH_CONV_IMPL",
+                                                   "conv")),
         optim=OptimConfig(lr=0.1, in_momentum=True),
         train=TrainConfig(local_step=LOCAL_STEPS),
         # BENCH_SCAN_UNROLL>1 lets XLA software-pipeline consecutive

@@ -110,6 +110,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="transformer attention backend: 'flash' = fused "
                         "online-softmax pallas kernel on TPU (exact; "
                         "dense fallback off-TPU)")
+    p.add_argument("--conv_impl", default="conv",
+                   choices=("conv", "matmul"),
+                   help="resnet conv lowering: 'matmul' = im2col + one "
+                        "batched matmul per layer (identical math; "
+                        "fills the MXU differently under per-client "
+                        "weights — see docs/performance.md)")
     # training scheme (parameters.py:118-141)
     p.add_argument("--stop_criteria", default="epoch")
     p.add_argument("--num_epochs", type=int, default=None)
@@ -248,7 +254,8 @@ def args_to_config(args) -> ExperimentConfig:
             moe_experts=args.moe_experts,
             moe_capacity_factor=args.moe_capacity_factor,
             moe_aux_weight=args.moe_aux_weight,
-            attention=args.attention),
+            attention=args.attention,
+            conv_impl=args.conv_impl),
         optim=OptimConfig(
             optimizer=args.optimizer, lr=args.lr,
             in_momentum=args.in_momentum,

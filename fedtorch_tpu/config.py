@@ -141,6 +141,12 @@ class ModelConfig:
     # §2.2; paper default 0.01). 0 disables; without it the top-1 gate
     # can collapse onto one expert.
     moe_aux_weight: float = 0.0
+    # resnet-family convolution lowering: 'conv' = XLA's native
+    # convolution; 'matmul' = im2col + one batched matmul per layer
+    # (identical params/math; fills the MXU differently under the
+    # federated engine's per-client weight axis — docs/performance.md
+    # "MFU roofline", measured by vmap_penalty_bench's conv_lowering)
+    conv_impl: str = "conv"
     # transformer attention backend: 'dense' (materialized scores) or
     # 'flash' (fused online-softmax pallas kernel on TPU, O(block^2)
     # score memory; exact, dense fallback off-TPU)
@@ -333,6 +339,10 @@ class ExperimentConfig:
             raise ValueError(
                 f"mesh.scan_unroll must be >= 1, got "
                 f"{self.mesh.scan_unroll}")
+        if self.model.conv_impl not in ("conv", "matmul"):
+            raise ValueError(
+                f"model.conv_impl must be 'conv' or 'matmul', got "
+                f"{self.model.conv_impl!r}")
 
         return dataclasses.replace(
             self, data=data, federated=fed, train=train, optim=optim)

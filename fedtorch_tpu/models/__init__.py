@@ -73,7 +73,8 @@ def define_model(cfg: ExperimentConfig, batch_size: int = 2) -> ModelDef:
     if arch.startswith("resnet"):
         module = build_resnet(arch, dataset, m.norm,
                               dtype=cfg.mesh.compute_dtype,
-                              remat=cfg.mesh.remat)
+                              remat=cfg.mesh.remat,
+                              conv_impl=m.conv_impl)
         return ModelDef(arch, module, _sample_image(dataset, batch_size))
     if arch.startswith("densenet"):
         module = build_densenet(arch, dataset, m.densenet_growth_rate,

@@ -9,13 +9,15 @@ measures the levers: it times the REAL federated trainer
 a grid of configurations and reports local-steps/sec/chip + analytic
 MFU for each:
 
-  base        B=50  bf16 unroll=1 k=10   (the north-star itself)
-  batch128    B=128 — 2.56x more rows per conv call
-  batch256    B=256 — 5.12x
-  f32         B=50 float32 — is bf16 actually buying anything?
-  unroll4     B=50 unroll=4 — XLA software-pipelining across local steps
-  batch128u4  B=128 unroll=4 — the two levers combined
-  online20    B=50 k=20 — more clients in flight per round
+  base          B=50  bf16 unroll=1 k=10   (the north-star itself)
+  batch128      B=128 — 2.56x more rows per conv call
+  batch256      B=256 — 5.12x
+  f32           B=50 float32 — is bf16 actually buying anything?
+  unroll4       B=50 unroll=4 — XLA software-pipelines local steps
+  batch128u4    B=128 unroll=4 — the two levers combined
+  online20      B=50 k=20 — more clients in flight per round
+  matmulconv    B=50 conv_impl=matmul — im2col batched-matmul lowering
+  matmulconv128 B=128 conv_impl=matmul — both levers
 
 MFU accounting: resnet20-cifar fwd = 40.8e6 MACs/image, train step =
 3x fwd, 2 FLOPs/MAC (identical to bench.py; per-image work is batch-
@@ -65,7 +67,7 @@ TRAIN_FLOPS_PER_IMAGE = 3 * 2 * 40.8e6  # bench.py's accounting
 
 
 def run_config(name, *, batch, dtype="bfloat16", unroll=1,
-               online_rate=0.1, profile_dir=None):
+               online_rate=0.1, conv_impl="conv", profile_dir=None):
     import jax
     from fedtorch_tpu.algorithms import make_algorithm
     from fedtorch_tpu.config import (
@@ -82,7 +84,7 @@ def run_config(name, *, batch, dtype="bfloat16", unroll=1,
             federated=True, num_clients=NUM_CLIENTS,
             online_client_rate=online_rate, algorithm="fedavg",
             sync_type="local_step"),
-        model=ModelConfig(arch="resnet20"),
+        model=ModelConfig(arch="resnet20", conv_impl=conv_impl),
         optim=OptimConfig(lr=0.1, in_momentum=True),
         train=TrainConfig(local_step=LOCAL_STEPS),
         mesh=MeshConfig(compute_dtype=dtype, scan_unroll=unroll),
@@ -129,6 +131,7 @@ def run_config(name, *, batch, dtype="bfloat16", unroll=1,
     mfu_pct = round(100 * achieved / (peak_tflops * 1e12), 2)
     row = {
         "batch": batch, "dtype": dtype, "scan_unroll": unroll,
+        "conv_impl": conv_impl,
         "k_online": int(trainer.k_online),
         "local_steps_per_sec_per_chip": round(steps_per_sec, 2),
         "images_per_sec": round(steps_per_sec * batch, 1),
@@ -175,6 +178,10 @@ def main():
         ("unroll4", dict(batch=50, unroll=4)),
         ("batch128u4", dict(batch=128, unroll=4)),
         ("online20", dict(batch=50, online_rate=0.2)),
+        # im2col batched-matmul conv lowering (models/common.py) — the
+        # model-level form of vmap_penalty_bench's conv_lowering A/B
+        ("matmulconv", dict(batch=50, conv_impl="matmul")),
+        ("matmulconv128", dict(batch=128, conv_impl="matmul")),
     ]
     results = {"platform": str(dev),
                "flops_accounting":

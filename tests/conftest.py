@@ -60,8 +60,14 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.slow)
     # surface staleness: a renamed test or changed parametrize id would
     # otherwise silently re-enter the fast lane. Only judge entries
-    # whose FILE was collected in this run, so path-restricted runs
-    # (pytest tests/test_foo.py) never warn spuriously.
+    # whose FILE was collected in this run (path-restricted runs never
+    # warn spuriously), and skip entirely when the invocation selects
+    # individual node ids or deselects tests — then partial matches
+    # are expected, not stale.
+    if any("::" in a for a in config.args) \
+            or config.getoption("deselect", None) \
+            or config.getoption("keyword", None):
+        return
     collected_files = {item.nodeid.split("::", 1)[0] for item in items}
     unmatched = {s for s in slow - matched
                  if s.split("::", 1)[0] in collected_files}
