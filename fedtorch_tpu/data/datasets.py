@@ -295,11 +295,34 @@ _LIBSVM_FILES = {
 }
 
 
+def _read_svmlight_dense(path: str, n_features=None):
+    """One svmlight file -> (dense f32 [n, f], labels). Native
+    multithreaded parser (native/pipeline.cpp:ft_svmlight_parse) when
+    available — epsilon is a ~12 GB text file, and parsing is the load
+    bottleneck — sklearn otherwise. Both paths parse the same decimal
+    strings to nearest-float, so results are identical."""
+    from fedtorch_tpu.native.host_pipeline import native_available, \
+        parse_svmlight
+    if native_available():
+        with open(path, "rb") as f:
+            raw = f.read()
+        if path.endswith(".bz2"):
+            import bz2
+            raw = bz2.decompress(raw)
+        parsed = parse_svmlight(raw, n_features=n_features)
+        if parsed is not None:
+            return parsed
+    # fallback streams from the path (sklearn decompresses .bz2
+    # itself) — no whole-file bytes copy on the degraded path
+    from sklearn.datasets import load_svmlight_file
+    x, y = load_svmlight_file(path, n_features=n_features)
+    return np.asarray(x.todense(), np.float32), y
+
+
 def load_libsvm(dataset: str, data_dir: str,
                 download: bool = False) -> DatasetSplits:
     """svmlight parse + standardize for MSD
     (ref: loader/libsvm_datasets.py:26-146)."""
-    from sklearn.datasets import load_svmlight_file
     train_name, test_name = _LIBSVM_FILES[dataset]
     base = os.path.join(data_dir, dataset)
 
@@ -312,13 +335,10 @@ def load_libsvm(dataset: str, data_dir: str,
                 return p
         raise _missing(dataset, os.path.join(base, stem))
 
-    tr = find(train_name)
-    x, y = load_svmlight_file(tr)
-    x = np.asarray(x.todense(), np.float32)
+    x, y = _read_svmlight_dense(find(train_name))
     te = find(test_name) if test_name else None
     if te:
-        tx, ty = load_svmlight_file(te, n_features=x.shape[1])
-        tx = np.asarray(tx.todense(), np.float32)
+        tx, ty = _read_svmlight_dense(te, n_features=x.shape[1])
     else:
         tx, ty = x[-1000:], y[-1000:]
         x, y = x[:-1000], y[:-1000]

@@ -64,6 +64,13 @@ def define_model(cfg: ExperimentConfig, batch_size: int = 2) -> ModelDef:
             "resnet*/wideresnet*/densenet*/transformer — the deep "
             "activation-heavy families); running without "
             "rematerialization", stacklevel=2)
+    if m.conv_impl != "conv" and not arch.startswith("resnet"):
+        import warnings
+        warnings.warn(
+            f"--conv_impl {m.conv_impl!r} has no effect for arch "
+            f"{arch!r} (implemented for resnet*); running with the "
+            "native conv lowering — an A/B against this arch would "
+            "measure two identical models", stacklevel=2)
     if arch.startswith("wideresnet"):
         module = build_wideresnet(arch, dataset, m.wideresnet_widen_factor,
                                   m.drop_rate, m.norm,

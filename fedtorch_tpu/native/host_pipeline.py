@@ -56,6 +56,18 @@ def load_library():
         lib.ft_cyclic_pad_indices.argtypes = [
             np.ctypeslib.ndpointer(np.int32), ctypes.c_int64,
             np.ctypeslib.ndpointer(np.int32), ctypes.c_int64]
+        lib.ft_svmlight_count.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64]
+        lib.ft_svmlight_count.restype = ctypes.c_int64
+        lib.ft_svmlight_scan.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.ft_svmlight_parse.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.float32),
+            np.ctypeslib.ndpointer(np.float32), ctypes.c_int32]
+        lib.ft_svmlight_parse.restype = ctypes.c_int32
         _lib = lib
     except OSError:
         _lib = None
@@ -101,6 +113,40 @@ def cyclic_pad_indices(idx: np.ndarray, n_out: int) -> np.ndarray:
     out = np.empty(n_out, np.int32)
     lib.ft_cyclic_pad_indices(idx, len(idx), out, n_out)
     return out
+
+
+def parse_svmlight(data: bytes, n_features: Optional[int] = None,
+                   num_threads: int = 0):
+    """Parse svmlight/libsvm text into a dense [n, f] float32 matrix
+    and float32 labels — the native multithreaded replacement for
+    sklearn's parser on the real-data path (data/datasets.py
+    load_libsvm). ``None`` when the native library is unavailable (the
+    caller falls back to sklearn). Raises ValueError on malformed
+    input (bad separator, out-of-range or non-ascending index)."""
+    lib = load_library()
+    if lib is None:
+        return None
+    if not data.endswith(b"\n"):
+        data = data + b"\n"  # the parser's line walker requires it
+    if n_features is None:
+        n_rows = ctypes.c_int64()
+        max_index = ctypes.c_int64()
+        lib.ft_svmlight_scan(data, len(data), ctypes.byref(n_rows),
+                             ctypes.byref(max_index))
+        n, f = int(n_rows.value), int(max_index.value)
+    else:
+        # known width: the cheap line count, no scan tokenization
+        n, f = int(lib.ft_svmlight_count(data, len(data))), \
+            int(n_features)
+    labels = np.empty(n, np.float32)
+    dense = np.empty((n, f), np.float32)
+    rc = lib.ft_svmlight_parse(data, len(data), f, labels,
+                               dense.reshape(-1), num_threads)
+    if rc != 0:
+        raise ValueError(
+            "malformed svmlight input (bad 'index:value' pair, index "
+            f"out of [1, {f}], or non-ascending indices)")
+    return dense, labels
 
 
 class HostPrefetcher:

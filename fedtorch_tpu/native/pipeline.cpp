@@ -13,7 +13,9 @@
 // Build: g++ -O3 -march=native -shared -fPIC -o libfedtorch_host.so
 //        pipeline.cpp -lpthread
 
+#include <atomic>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <thread>
 #include <vector>
@@ -84,6 +86,175 @@ void ft_gather_rows(const void* src, int64_t row_bytes,
 void ft_cyclic_pad_indices(const int32_t* idx, int64_t n_idx,
                            int32_t* out, int64_t n_out) {
   for (int64_t k = 0; k < n_out; ++k) out[k] = idx[k % n_idx];
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// svmlight/libsvm text parser (the LibSVM datasets' on-disk format:
+// "<label> <index>:<value> ...", 1-based ascending sparse indices,
+// '#' comments — see tests/format_fixtures.py for the spec notes).
+// Replaces sklearn's Python/Cython parser on the real-data path
+// (epsilon is a ~12 GB text file; parse speed is the load bottleneck).
+// The buffer must end with '\n' (the Python wrapper guarantees it).
+
+namespace {
+
+struct LineRange {
+  const char* begin;
+  const char* end;  // exclusive, at the '\n'
+};
+
+// Collect [begin, end) of every DATA line (non-empty after whitespace,
+// not a '#' comment line).
+static std::vector<LineRange> data_lines(const char* buf, int64_t len) {
+  std::vector<LineRange> lines;
+  const char* p = buf;
+  const char* limit = buf + len;
+  while (p < limit) {
+    const char* nl = static_cast<const char*>(
+        std::memchr(p, '\n', static_cast<size_t>(limit - p)));
+    if (nl == nullptr) nl = limit;
+    const char* q = p;
+    while (q < nl && (*q == ' ' || *q == '\t' || *q == '\r')) ++q;
+    if (q < nl && *q != '#') lines.push_back({q, nl});
+    p = nl + 1;
+  }
+  return lines;
+}
+
+// Parse one data line into labels[row] and dense[row * n_features].
+// Returns false on malformed input (bad separator, index out of
+// [1, n_features], non-ascending index).
+static bool parse_line(const LineRange& ln, int64_t n_features,
+                       float* label, float* dense_row) {
+  char* cursor = nullptr;
+  *label = std::strtof(ln.begin, &cursor);
+  if (cursor == ln.begin) return false;
+  const char* p = cursor;
+  int64_t prev_idx = 0;
+  while (p < ln.end) {
+    while (p < ln.end && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
+    if (p >= ln.end || *p == '#') break;  // trailing comment
+    long long idx = std::strtoll(p, &cursor, 10);
+    if (cursor == p || cursor >= ln.end || *cursor != ':') return false;
+    if (idx < 1 || idx > n_features || idx <= prev_idx) return false;
+    prev_idx = idx;
+    p = cursor + 1;
+    // the value must start HERE: strtof skips leading whitespace
+    // (including '\n'), so a missing value would otherwise silently
+    // consume the next line's label
+    if (p >= ln.end || *p == ' ' || *p == '\t' || *p == '\r') {
+      return false;
+    }
+    float v = std::strtof(p, &cursor);
+    if (cursor == p || cursor > ln.end) return false;
+    dense_row[idx - 1] = v;
+    p = cursor;
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Number of data rows only — O(bytes) memchr walk, no tokenization.
+// Callers that already know n_features (the test-split path) use this
+// instead of the scan, so the serial pass stays cheap (Amdahl).
+int64_t ft_svmlight_count(const char* buf, int64_t len) {
+  return static_cast<int64_t>(data_lines(buf, len).size());
+}
+
+// Pass 1: number of data rows and the maximum feature index seen.
+// Indices ascend within a line, so only the LAST "idx:val" token needs
+// parsing — a backward walk per line, not a full tokenization (the
+// full-tokenization fallback handles lines with '#' comments).
+void ft_svmlight_scan(const char* buf, int64_t len, int64_t* n_rows,
+                      int64_t* max_index) {
+  auto lines = data_lines(buf, len);
+  *n_rows = static_cast<int64_t>(lines.size());
+  int64_t mx = 0;
+  char* cursor = nullptr;
+  for (const auto& ln : lines) {
+    int64_t row_max = 0;
+    const char* hash = static_cast<const char*>(std::memchr(
+        ln.begin, '#', static_cast<size_t>(ln.end - ln.begin)));
+    if (hash == nullptr) {
+      // fast path: trim trailing whitespace, take the last token
+      const char* e = ln.end;
+      while (e > ln.begin &&
+             (*(e - 1) == ' ' || *(e - 1) == '\t' || *(e - 1) == '\r'))
+        --e;
+      const char* sp = e;
+      while (sp > ln.begin && *(sp - 1) != ' ' && *(sp - 1) != '\t')
+        --sp;
+      if (sp > ln.begin) {  // a pair exists (not just the label)
+        long long idx = std::strtoll(sp, &cursor, 10);
+        if (cursor != sp && cursor < e && *cursor == ':') row_max = idx;
+      }
+    } else {
+      // comment on the line: tokenize forward up to the '#'
+      const char* q = ln.begin;
+      std::strtof(q, &cursor);  // skip label
+      q = cursor;
+      while (q < ln.end) {
+        while (q < ln.end && (*q == ' ' || *q == '\t' || *q == '\r'))
+          ++q;
+        if (q >= ln.end || *q == '#') break;
+        long long idx = std::strtoll(q, &cursor, 10);
+        if (cursor == q || cursor >= ln.end || *cursor != ':') break;
+        if (idx > row_max) row_max = idx;
+        q = cursor + 1;
+        std::strtof(q, &cursor);
+        if (cursor == q) break;
+        q = cursor;
+      }
+    }
+    if (row_max > mx) mx = row_max;
+  }
+  *max_index = mx;
+}
+
+// Pass 2: fill labels[n_rows] and zero-initialized
+// dense[n_rows * n_features], multithreaded over line ranges.
+// Returns 0 on success, -1 if any line is malformed.
+int32_t ft_svmlight_parse(const char* buf, int64_t len,
+                          int64_t n_features, float* labels,
+                          float* dense, int32_t num_threads) {
+  auto lines = data_lines(buf, len);
+  const int64_t n = static_cast<int64_t>(lines.size());
+  std::memset(dense, 0,
+              static_cast<size_t>(n * n_features) * sizeof(float));
+  int threads = num_threads > 0
+                    ? num_threads
+                    : static_cast<int32_t>(
+                          std::thread::hardware_concurrency());
+  if (threads < 1) threads = 1;
+  std::atomic<int32_t> bad{0};
+  auto work = [&](int64_t lo, int64_t hi) {
+    for (int64_t r = lo; r < hi; ++r) {
+      if (!parse_line(lines[static_cast<size_t>(r)], n_features,
+                      labels + r, dense + r * n_features)) {
+        bad.store(1, std::memory_order_relaxed);
+        return;
+      }
+    }
+  };
+  if (threads == 1 || n < 4 * threads) {
+    work(0, n);
+  } else {
+    std::vector<std::thread> pool;
+    int64_t chunk = (n + threads - 1) / threads;
+    for (int t = 0; t < threads; ++t) {
+      int64_t lo = t * chunk;
+      int64_t hi = lo + chunk < n ? lo + chunk : n;
+      if (lo >= hi) break;
+      pool.emplace_back(work, lo, hi);
+    }
+    for (auto& th : pool) th.join();
+  }
+  return bad.load() ? -1 : 0;
 }
 
 }  // extern "C"
