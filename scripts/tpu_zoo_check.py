@@ -151,6 +151,16 @@ def _model_cases():
                    rng.randint(0, 10, 64), dataset="cifar10",
                    dtype="bfloat16", mesh_kw={"remat": True})
 
+    def resnet_matmulconv_bf16():
+        # the im2col batched-matmul conv lowering through the real MXU
+        # (models/common.py MatmulConv — the MFU lever; mfu_sweep times
+        # it, this proves lowering + a finite training round)
+        return run("resnet20",
+                   rng.randn(64, 32, 32, 3).astype(np.float32),
+                   rng.randint(0, 10, 64), dataset="cifar10",
+                   dtype="bfloat16",
+                   model_kw={"conv_impl": "matmul"})
+
     def batched_rounds():
         # the single-dispatch scan driver (bench fast path) on the chip
         parts = [np.arange(i * 16, (i + 1) * 16) for i in range(4)]
@@ -182,6 +192,8 @@ def _model_cases():
             ("transformer_bf16", transformer_lm, "loss"),
             ("transformer_flash_moe_bf16", transformer_flash_moe, "loss"),
             ("resnet20_remat_bf16", resnet_remat_bf16, "loss"),
+            ("resnet20_matmulconv_bf16", resnet_matmulconv_bf16,
+             "loss"),
             ("batched_rounds_scan", batched_rounds, "loss"),
             ("local_sgd_cnn_bf16", local_sgd, "loss"),
             ("seqpar_1chip", seqpar_single_chip, "err")]
