@@ -112,10 +112,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "dense fallback off-TPU)")
     p.add_argument("--conv_impl", default="conv",
                    choices=("conv", "matmul"),
-                   help="resnet conv lowering: 'matmul' = im2col + one "
-                        "batched matmul per layer (identical math; "
-                        "fills the MXU differently under per-client "
-                        "weights — see docs/performance.md)")
+                   help="conv-family lowering (resnet/wideresnet/"
+                        "densenet/cnn): 'matmul' = im2col + one batched "
+                        "matmul per layer (identical math; fills the "
+                        "MXU differently under per-client weights — "
+                        "see docs/performance.md)")
     # training scheme (parameters.py:118-141)
     p.add_argument("--stop_criteria", default="epoch")
     p.add_argument("--num_epochs", type=int, default=None)

@@ -64,18 +64,21 @@ def define_model(cfg: ExperimentConfig, batch_size: int = 2) -> ModelDef:
             "resnet*/wideresnet*/densenet*/transformer — the deep "
             "activation-heavy families); running without "
             "rematerialization", stacklevel=2)
-    if m.conv_impl != "conv" and not arch.startswith("resnet"):
+    if m.conv_impl != "conv" and not arch.startswith(
+            ("resnet", "wideresnet", "densenet", "cnn")):
         import warnings
         warnings.warn(
             f"--conv_impl {m.conv_impl!r} has no effect for arch "
-            f"{arch!r} (implemented for resnet*); running with the "
-            "native conv lowering — an A/B against this arch would "
-            "measure two identical models", stacklevel=2)
+            f"{arch!r} (implemented for the conv families: resnet*/"
+            "wideresnet*/densenet*/cnn); running with the native conv "
+            "lowering — an A/B against this arch would measure two "
+            "identical models", stacklevel=2)
     if arch.startswith("wideresnet"):
         module = build_wideresnet(arch, dataset, m.wideresnet_widen_factor,
                                   m.drop_rate, m.norm,
                                   dtype=cfg.mesh.compute_dtype,
-                                  remat=cfg.mesh.remat)
+                                  remat=cfg.mesh.remat,
+                                  conv_impl=m.conv_impl)
         return ModelDef(arch, module, _sample_image(dataset, batch_size))
     if arch.startswith("resnet"):
         module = build_resnet(arch, dataset, m.norm,
@@ -88,7 +91,8 @@ def define_model(cfg: ExperimentConfig, batch_size: int = 2) -> ModelDef:
                                 m.densenet_bc_mode, m.densenet_compression,
                                 m.drop_rate, m.norm,
                                 dtype=cfg.mesh.compute_dtype,
-                                remat=cfg.mesh.remat)
+                                remat=cfg.mesh.remat,
+                                conv_impl=m.conv_impl)
         return ModelDef(arch, module, _sample_image(dataset, batch_size))
     if arch == "logistic_regression":
         return ModelDef(arch, LogisticRegression(
@@ -126,7 +130,8 @@ def define_model(cfg: ExperimentConfig, batch_size: int = 2) -> ModelDef:
     if arch == "cnn":
         return ModelDef(arch,
                         CNN(dataset=dataset,
-                            dtype=cfg.mesh.compute_dtype),
+                            dtype=cfg.mesh.compute_dtype,
+                            conv_impl=m.conv_impl),
                         _sample_image(dataset, batch_size))
     if arch == "rnn":
         module = CharGRU(vocab_size=m.vocab_size,

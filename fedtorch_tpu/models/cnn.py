@@ -10,21 +10,26 @@ from __future__ import annotations
 import flax.linen as nn
 import jax.numpy as jnp
 
-from fedtorch_tpu.models.common import num_classes_of
+from fedtorch_tpu.models.common import conv_of, num_classes_of
 
 
 class CNN(nn.Module):
     dataset: str
     dtype: str = "float32"
+    conv_impl: str = "conv"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         dt = jnp.dtype(self.dtype)
+        # explicit Conv_N names = nn.Conv auto-names (see resnet.py)
+        Conv = conv_of(self.conv_impl)
         x = x.astype(dt)
-        x = nn.Conv(20, (5, 5), padding="VALID", dtype=dt)(x)
+        x = Conv(20, (5, 5), padding="VALID", dtype=dt, use_bias=True,
+                 name="Conv_0")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
-        x = nn.Conv(50, (5, 5), padding="VALID", dtype=dt)(x)
+        x = Conv(50, (5, 5), padding="VALID", dtype=dt, use_bias=True,
+                 name="Conv_1")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = x.reshape((x.shape[0], -1))

@@ -87,6 +87,37 @@ class TestResNetToggle:
             rel = np.max(np.abs(u - v)) / (np.max(np.abs(u)) + 1e-12)
             assert rel < 2e-2, rel
 
+    def test_wideresnet_densenet_cnn_toggle(self):
+        """The whole conv family honors conv_impl with identical trees
+        (incl. densenet's bc/non-bc conditional conv naming and cnn's
+        biased VALID-padding convs)."""
+        from fedtorch_tpu.models.cnn import CNN
+        from fedtorch_tpu.models.densenet import build_densenet
+        from fedtorch_tpu.models.wideresnet import build_wideresnet
+
+        x = jax.random.normal(jax.random.key(0), (2, 32, 32, 3))
+        builds = [
+            lambda impl: build_wideresnet(
+                "wideresnet10", "cifar10", 1, 0.0, "gn",
+                conv_impl=impl),
+            lambda impl: build_densenet(
+                "densenet13", "cifar10", 8, False, 1.0, 0.0, "gn",
+                conv_impl=impl),
+            lambda impl: build_densenet(
+                "densenet16", "cifar10", 8, True, 0.5, 0.0, "gn",
+                conv_impl=impl),
+            lambda impl: CNN(dataset="cifar10", conv_impl=impl),
+        ]
+        for build in builds:
+            a, b = build("conv"), build("matmul")
+            params = a.init(jax.random.key(1), x)["params"]
+            assert _tree_shapes(params) == _tree_shapes(
+                b.init(jax.random.key(1), x)["params"])
+            np.testing.assert_allclose(
+                np.asarray(a.apply({"params": params}, x)),
+                np.asarray(b.apply({"params": params}, x)),
+                atol=5e-5, rtol=5e-5)
+
     def test_imagenet_stem_toggle(self):
         x = jax.random.normal(jax.random.key(0), (1, 64, 64, 3))
         a = build_resnet("resnet18", "imagenet", "gn")
