@@ -49,6 +49,24 @@ class TestMatmulConvModule:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=5e-4, rtol=5e-4)
 
+    def test_bf16_compute_parity(self):
+        """bf16 compute (the on-chip mode): both impls cast params to
+        bf16 and produce matching outputs at bf16 tolerance, with f32
+        params preserved."""
+        x = jax.random.normal(jax.random.key(0), (2, 16, 16, 8))
+        ref = nn.Conv(16, (3, 3), padding=1, use_bias=False,
+                      dtype=jnp.bfloat16)
+        alt = MatmulConv(16, (3, 3), padding=1, use_bias=False,
+                         dtype=jnp.bfloat16)
+        params = ref.init(jax.random.key(1), x)
+        assert jax.tree.leaves(params)[0].dtype == jnp.float32
+        ya = ref.apply(params, x)
+        yb = alt.apply(params, x)
+        assert ya.dtype == yb.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(ya, np.float32), np.asarray(yb, np.float32),
+            atol=5e-2, rtol=5e-2)
+
     def test_bias_and_unknown_impl(self):
         x = jnp.ones((1, 4, 4, 2))
         m = MatmulConv(3, (3, 3), padding=1, use_bias=True)
