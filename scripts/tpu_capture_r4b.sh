@@ -1,0 +1,22 @@
+#!/bin/bash
+# Round-4 stage 3: after stages 1+2 finish, certify the wedged-relay
+# replay path with the REAL capture (scripts/wedge_replay_check.py).
+# Touches no relay (the check stubs the probe), so it is safe to run
+# regardless of relay state; it no-ops (rc 2) if no real capture landed.
+#     nohup bash scripts/tpu_capture_r4b.sh > /tmp/tpu_capture_r4b.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.." || exit 1
+
+# only certify a capture taken AFTER this launch (a prior round's
+# leftover file must not produce a spurious "verified" transcript)
+export WEDGE_MIN_CAPTURED_UNIX="$(date +%s)"
+
+while pgrep -f "bash scripts/tpu_capture_full.sh" > /dev/null \
+      || pgrep -f "bash scripts/tpu_capture_r4.sh" > /dev/null; do
+    sleep 120
+done
+echo "[tpu_capture_r4b] stages 1+2 done — running the replay check"
+python scripts/wedge_replay_check.py
+rc=$?
+echo "[tpu_capture_r4b] wedge_replay_check rc=$rc (0=verified, 2=no capture)"
+exit $rc

@@ -1,0 +1,89 @@
+"""Prove the wedged-relay bench replay with a REAL capture
+(VERDICT r3 #4, final leg).
+
+Once a live on-chip bench run has persisted TPU_BENCH_CAPTURE.json,
+this script simulates a wedge (probe stubbed False — touches no relay)
+and runs ``bench.main()`` end-to-end, asserting the emitted record
+replays the capture with machine-readable provenance. The passing
+transcript is appended to docs/wedge_report_drive.md.
+
+Exit codes: 0 = verified; 2 = no real capture present (nothing to
+prove yet); 1 = replay failed (the record did NOT match the capture).
+"""
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main():
+    import bench
+
+    if not os.path.exists(bench.TPU_CAPTURE_PATH):
+        print("no TPU_BENCH_CAPTURE.json yet — nothing to prove",
+              file=sys.stderr)
+        return 2
+    with open(bench.TPU_CAPTURE_PATH) as f:
+        cap = json.load(f)
+    if "SYNTHETIC" in cap.get("notes", ""):
+        print("capture is synthetic — refusing to certify with it",
+              file=sys.stderr)
+        return 2
+    # a stale capture from BEFORE this pipeline launched (e.g. a prior
+    # round's file) must not be certified as this round's
+    min_unix = int(os.environ.get("WEDGE_MIN_CAPTURED_UNIX", "0"))
+    if cap.get("captured_unix", 0) < min_unix:
+        print("capture predates this pipeline launch — not certifying",
+              file=sys.stderr)
+        return 2
+
+    bench.probe_device = lambda *a, **k: False  # simulated wedge
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        bench.main()
+    line = out.getvalue().strip().splitlines()[-1]
+    rec = json.loads(line)
+
+    if rec.get("cached") is not True:
+        # bench REFUSED the capture (stale >24h / ancestry) and emitted
+        # the honest CPU record — a by-design refusal, not a broken
+        # replay path; report it distinctly
+        print("bench refused the capture (stale or unverifiable) and "
+              "emitted the live CPU record — refusal path exercised, "
+              f"replay not certified:\n{line}", file=sys.stderr)
+        return 2
+
+    ok = (rec.get("cached") is True
+          and rec.get("value") == cap["value"]
+          and rec.get("vs_baseline") == cap["vs_baseline"]
+          and rec.get("captured_at") == cap["captured_at"]
+          and rec.get("git_head") == cap["git_head"])
+    if not ok:
+        print(f"REPLAY MISMATCH:\ncapture={json.dumps(cap)}\n"
+              f"record={json.dumps(rec)}", file=sys.stderr)
+        return 1
+
+    stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    with open(os.path.join(REPO, "docs", "wedge_report_drive.md"),
+              "a") as f:
+        f.write(
+            f"\n## REAL-capture replay verified ({stamp})\n\n"
+            "With a live on-chip capture present, `bench.main()` under "
+            "a simulated wedge (probe stubbed; no relay touched) "
+            "emitted exactly the capture with machine-readable "
+            "provenance:\n\n```json\n" + line + "\n```\n")
+    print(json.dumps({"wedge_replay_verified": True,
+                      "value": rec["value"],
+                      "captured_at": rec["captured_at"]}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
