@@ -136,6 +136,25 @@ class TestResNetToggle:
                 np.asarray(b.apply({"params": params}, x)),
                 atol=5e-5, rtol=5e-5)
 
+    def test_composes_with_remat(self):
+        """remat wraps blocks that instantiate MatmulConv inside —
+        the two knobs must compose with identical trees and outputs."""
+        x = jax.random.normal(jax.random.key(0), (2, 32, 32, 3))
+        plain = build_resnet("resnet8", "cifar10", "gn",
+                             conv_impl="matmul")
+        both = build_resnet("resnet8", "cifar10", "gn", remat=True,
+                            conv_impl="matmul")
+        params = plain.init(jax.random.key(1), x)["params"]
+        assert _tree_shapes(params) == _tree_shapes(
+            both.init(jax.random.key(1), x)["params"])
+        np.testing.assert_allclose(
+            np.asarray(plain.apply({"params": params}, x)),
+            np.asarray(both.apply({"params": params}, x)), atol=1e-6)
+        g = jax.grad(lambda p: jnp.sum(
+            both.apply({"params": p}, x) ** 2))(params)
+        assert all(bool(jnp.all(jnp.isfinite(v)))
+                   for v in jax.tree.leaves(g))
+
     def test_imagenet_stem_toggle(self):
         x = jax.random.normal(jax.random.key(0), (1, 64, 64, 3))
         a = build_resnet("resnet18", "imagenet", "gn")
