@@ -238,18 +238,20 @@ def main():
     profile_dir = os.path.join(repo, "artifacts", "trace_northstar") \
         if os.environ.get("MFU_PROFILE") == "1" else None
 
+    # ordered by information value: a mid-sweep relay wedge keeps the
+    # most decisive configs (results persist incrementally)
     grid = [
         ("base", dict(batch=50, profile_dir=profile_dir)),
+        # im2col batched-matmul conv lowering (models/common.py) — the
+        # model-level form of vmap_penalty_bench's conv_lowering A/B
+        ("matmulconv", dict(batch=50, conv_impl="matmul")),
         ("batch128", dict(batch=128)),
+        ("matmulconv128", dict(batch=128, conv_impl="matmul")),
         ("batch256", dict(batch=256)),
         ("f32", dict(batch=50, dtype="float32")),
         ("unroll4", dict(batch=50, unroll=4)),
         ("batch128u4", dict(batch=128, unroll=4)),
         ("online20", dict(batch=50, online_rate=0.2)),
-        # im2col batched-matmul conv lowering (models/common.py) — the
-        # model-level form of vmap_penalty_bench's conv_lowering A/B
-        ("matmulconv", dict(batch=50, conv_impl="matmul")),
-        ("matmulconv128", dict(batch=128, conv_impl="matmul")),
         # bottleneck blocks reach 256 output channels — escapes the
         # N-lane roofline bound (docs/performance.md): high MFU here +
         # low MFU on resnet20 = the underfill is the benchmark model,
