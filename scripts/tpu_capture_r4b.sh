@@ -12,7 +12,8 @@ cd "$(dirname "$0")/.." || exit 1
 export WEDGE_MIN_CAPTURED_UNIX="$(date +%s)"
 
 while pgrep -f "bash scripts/tpu_capture_full.sh" > /dev/null \
-      || pgrep -f "bash scripts/tpu_capture_r4.sh" > /dev/null; do
+      || pgrep -f "bash scripts/tpu_capture_r4.sh" > /dev/null \
+      || pgrep -f "bash scripts/tpu_capture_r4c.sh" > /dev/null; do
     sleep 120
 done
 echo "[tpu_capture_r4b] stages 1+2 done — running the replay check"
