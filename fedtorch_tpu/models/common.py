@@ -131,6 +131,14 @@ class MatmulConv(nn.Module):
     compute in ``dtype``), so checkpoints are loadable across the
     toggle. Supports the subset the conv zoo uses: NHWC input, integer
     or pair padding, strides, optional bias.
+
+    Cost trade to keep in mind when reading the A/B: the materialized
+    patches are kh*kw x the activation size (9x for 3x3), so this
+    formulation buys MXU-friendly matmul tiling with extra HBM traffic
+    and activation memory — XLA may fuse the extraction, and ``remat``
+    keeps the backward from storing patches across layers, but whether
+    the tiling win beats the bandwidth cost is exactly what
+    MFU_SWEEP.json / VMAP_PENALTY.json's conv_lowering measure.
     """
     features: int
     kernel_size: tuple
