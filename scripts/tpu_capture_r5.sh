@@ -1,0 +1,89 @@
+#!/bin/bash
+# Round-5 capture chain (VERDICT r4 item #1): keep a probe loop running
+# from the round's first minute to its last, and convert a relay window
+# of ANY length into the priority-ordered capture — default bench.py
+# first (persists TPU_BENCH_CAPTURE.json, the north-star record the
+# driver can replay), then the measurement queue ordered by information
+# value: conv A/B, MFU sweep, conv-lowering sweep, MoE A/B, flash
+# lowering, zoo, baseline suite. Finally certify the wedge-replay path
+# against the REAL capture (VERDICT r4 item #3).
+#
+# Single-session relay discipline: waits for ALL round-4 stages to
+# exit before issuing its own probes (two concurrent probes contend),
+# runs strictly serially, and NEVER wraps a relay-touching run in
+# `timeout` (a killed grant-waiter wedged the relay in round 2).
+#
+#     nohup bash scripts/tpu_capture_r5.sh > /tmp/tpu_capture_r5.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.." || exit 1
+
+# The launch time only bounds the HARD end (stay clear of the driver's
+# round-end bench, ~12 h after the round starts); the probe budget
+# itself is anchored AFTER the round-4 wait below, so a long wait
+# cannot eat the probing window down to zero probes.
+LAUNCH="$(date +%s)"
+HARD_END="${TPU_CAPTURE_HARD_END_UNIX:-$(( LAUNCH + 39600 ))}"   # 11 h
+
+while pgrep -f "bash scripts/tpu_capture_r4.sh" > /dev/null \
+      || pgrep -f "bash scripts/tpu_capture_r4c.sh" > /dev/null \
+      || pgrep -f "bash scripts/tpu_capture_r4b.sh" > /dev/null; do
+    sleep 120
+done
+
+# certification below must only accept a capture taken by THIS chain —
+# stamped after the wait so a round-4 stage's own late capture (already
+# certified by tpu_capture_r4b) cannot satisfy this chain's check
+export WEDGE_MIN_CAPTURED_UNIX="$(date +%s)"
+
+DEADLINE="${TPU_CAPTURE_DEADLINE_UNIX:-$(( $(date +%s) + 36000 ))}"  # 10 h of probing
+[ "$DEADLINE" -gt "$HARD_END" ] && DEADLINE="$HARD_END"
+echo "[tpu_capture_r5] round-4 stages done — probing until $(date -u -d "@$DEADLINE" +%H:%M:%S) UTC"
+
+GRANTED=0
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+    BENCH_PROBE_TRIES=5 python - <<'EOF'
+import sys
+sys.path.insert(0, ".")
+from bench import probe_device
+sys.exit(0 if probe_device() else 1)
+EOF
+    if [ $? -eq 0 ]; then
+        GRANTED=1
+        break
+    fi
+    echo "[tpu_capture_r5] relay still dead at $(date -u +%H:%M:%S) UTC"
+    sleep 60
+done
+
+if [ "$GRANTED" -ne 1 ]; then
+    echo "[tpu_capture_r5] relay never recovered before the deadline; nothing captured"
+    exit 1
+fi
+
+echo "[tpu_capture_r5] relay alive — capturing (sequential, bench first)"
+FAILED=0
+run() {
+    echo "=== $* ==="
+    BENCH_PROBE_TRIES=2 "$@"
+    local rc=$?
+    echo "=== rc=$rc ==="
+    [ $rc -ne 0 ] && FAILED=1
+}
+
+run python bench.py                              # north star -> TPU_BENCH_CAPTURE.json FIRST
+run env BENCH_CONV_IMPL=matmul python bench.py   # conv-lowering A/B on the north star
+run python scripts/mfu_sweep.py                  # -> MFU_SWEEP.json (lever grid)
+run python scripts/vmap_penalty_bench.py         # -> VMAP_PENALTY.json (conv A/B detail)
+run python scripts/moe_ab_bench.py               # -> MOE_AB.json (dense vs sparse dispatch)
+run python scripts/pallas_tpu_check.py           # -> PALLAS_TPU.json (flash under real Mosaic)
+run python scripts/flash_train_bench.py          # -> FLASH_TRAIN.json
+run python scripts/tpu_zoo_check.py              # -> TPU_ZOO.json
+run python scripts/baseline_suite.py             # -> BASELINE_SUITE.json
+run python bench.py                              # re-persist at default config
+echo "[tpu_capture_r5] capture done (failed=$FAILED) — certifying wedge replay"
+
+python scripts/wedge_replay_check.py
+rc=$?
+echo "[tpu_capture_r5] wedge_replay_check rc=$rc (0=verified, 2=no capture)"
+echo "[tpu_capture_r5] done"
+exit $FAILED

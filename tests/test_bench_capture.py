@@ -135,3 +135,37 @@ class TestRefusals:
         with open(bench.TPU_CAPTURE_PATH, "w") as f:
             json.dump(rec, f)
         assert bench._load_fresh_capture(0.58) is None
+
+
+class TestDefaultConfigPersistGate:
+    """Only a default-config bench run may persist the capture: a relay
+    wedge right after a variant run must not leave an A/B number
+    masquerading as the north-star record (code-review round 5)."""
+
+    def test_default_env_is_default(self, bench, monkeypatch):
+        for knob in ("BENCH_CONV_IMPL", "BENCH_DTYPE",
+                     "BENCH_SCAN_UNROLL", "BENCH_SINGLE_DISPATCH"):
+            monkeypatch.delenv(knob, raising=False)
+        assert bench.is_default_bench_config()
+
+    @pytest.mark.parametrize("knob,value", [
+        ("BENCH_CONV_IMPL", "matmul"),
+        ("BENCH_DTYPE", "float32"),
+        ("BENCH_SCAN_UNROLL", "4"),
+        ("BENCH_SINGLE_DISPATCH", "0"),
+    ])
+    def test_every_ab_knob_blocks_persistence(self, bench, monkeypatch,
+                                              knob, value):
+        monkeypatch.setenv(knob, value)
+        assert not bench.is_default_bench_config()
+
+    @pytest.mark.parametrize("knob,value", [
+        ("BENCH_CONV_IMPL", "conv"),
+        ("BENCH_DTYPE", "bfloat16"),
+        ("BENCH_SCAN_UNROLL", "1"),
+        ("BENCH_SINGLE_DISPATCH", "1"),
+    ])
+    def test_explicit_defaults_still_default(self, bench, monkeypatch,
+                                             knob, value):
+        monkeypatch.setenv(knob, value)
+        assert bench.is_default_bench_config()
