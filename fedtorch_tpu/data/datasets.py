@@ -295,23 +295,76 @@ _LIBSVM_FILES = {
 }
 
 
+def _read_file_bytes(path: str) -> bytearray:
+    """Whole file as ONE mutable bytearray. ``.bz2`` is decompressed
+    incrementally (epsilon is ~12 GB of text — never hold the
+    compressed and decompressed copies at once); plain files are read
+    straight into the output buffer with no intermediate bytes copy."""
+    if path.endswith(".bz2"):
+        import bz2
+        out = bytearray()
+        dec = bz2.BZ2Decompressor()
+        with open(path, "rb") as f:
+            while True:
+                data = f.read(1 << 24)
+                if not data:
+                    break
+                while data:
+                    if dec.eof:
+                        # concatenated bz2 streams (pbzip2/lbzip2;
+                        # bz2.decompress parity) — a stream may end at
+                        # a chunk boundary, so a fresh decompressor is
+                        # started whenever bytes follow an EOF
+                        dec = bz2.BZ2Decompressor()
+                    out += dec.decompress(data)
+                    data = dec.unused_data if dec.eof else b""
+        if out and not dec.eof:
+            # bz2.decompress parity: a truncated archive must fail
+            # loudly, not yield a silently shortened dataset
+            raise ValueError(
+                f"{path}: compressed data ended before the "
+                "end-of-stream marker was reached")
+        return out
+    size = os.path.getsize(path)
+    buf = bytearray(size)
+    view = memoryview(buf)
+    filled = 0
+    with open(path, "rb", buffering=0) as f:
+        # one readinto can short-read (Linux caps a single read(2) at
+        # ~2 GiB — epsilon is ~12 GB); loop until the buffer is full
+        while filled < size:
+            n = f.readinto(view[filled:])
+            if not n:
+                raise OSError(f"{path}: file shrank while reading "
+                              f"({filled}/{size} bytes)")
+            filled += n
+    return buf
+
+
 def _read_svmlight_dense(path: str, n_features=None):
     """One svmlight file -> (dense f32 [n, f], labels). Native
     multithreaded parser (native/pipeline.cpp:ft_svmlight_parse) when
     available — epsilon is a ~12 GB text file, and parsing is the load
     bottleneck — sklearn otherwise. Both paths parse the same decimal
-    strings to nearest-float, so results are identical."""
+    strings to nearest-float, so results are identical. The native
+    parser is a pure accelerator: input it rejects (non-ascending or
+    duplicate indices, unusual separators) falls through to sklearn
+    rather than becoming a new failure mode."""
     from fedtorch_tpu.native.host_pipeline import native_available, \
         parse_svmlight
     if native_available():
-        with open(path, "rb") as f:
-            raw = f.read()
-        if path.endswith(".bz2"):
-            import bz2
-            raw = bz2.decompress(raw)
-        parsed = parse_svmlight(raw, n_features=n_features)
-        if parsed is not None:
-            return parsed
+        try:
+            parsed = parse_svmlight(_read_file_bytes(path),
+                                    n_features=n_features)
+            if parsed is not None:
+                return parsed
+        # ValueError: parser rejected the text; OSError/EOFError: a
+        # corrupt or trailing-garbage .bz2 — in every case sklearn
+        # gets its own chance at the file
+        except (ValueError, OSError, EOFError) as e:
+            import sys
+            print(f"warning: native svmlight parser rejected {path} "
+                  f"({e}); falling back to sklearn", file=sys.stderr)
     # fallback streams from the path (sklearn decompresses .bz2
     # itself) — no whole-file bytes copy on the degraded path
     from sklearn.datasets import load_svmlight_file

@@ -56,15 +56,17 @@ def load_library():
         lib.ft_cyclic_pad_indices.argtypes = [
             np.ctypeslib.ndpointer(np.int32), ctypes.c_int64,
             np.ctypeslib.ndpointer(np.int32), ctypes.c_int64]
+        # POINTER(c_char) rather than c_char_p so a mutable bytearray
+        # (via (c_char * n).from_buffer) passes zero-copy alongside bytes
         lib.ft_svmlight_count.argtypes = [
-            ctypes.c_char_p, ctypes.c_int64]
+            ctypes.POINTER(ctypes.c_char), ctypes.c_int64]
         lib.ft_svmlight_count.restype = ctypes.c_int64
         lib.ft_svmlight_scan.argtypes = [
-            ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_char), ctypes.c_int64,
             ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_int64)]
         lib.ft_svmlight_parse.argtypes = [
-            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_char), ctypes.c_int64, ctypes.c_int64,
             np.ctypeslib.ndpointer(np.float32),
             np.ctypeslib.ndpointer(np.float32), ctypes.c_int32]
         lib.ft_svmlight_parse.restype = ctypes.c_int32
@@ -115,7 +117,8 @@ def cyclic_pad_indices(idx: np.ndarray, n_out: int) -> np.ndarray:
     return out
 
 
-def parse_svmlight(data: bytes, n_features: Optional[int] = None,
+def parse_svmlight(data: "bytes | bytearray",
+                   n_features: Optional[int] = None,
                    num_threads: int = 0):
     """Parse svmlight/libsvm text into a dense [n, f] float32 matrix
     and float32 labels — the native multithreaded replacement for
@@ -127,20 +130,29 @@ def parse_svmlight(data: bytes, n_features: Optional[int] = None,
     if lib is None:
         return None
     if not data.endswith(b"\n"):
-        data = data + b"\n"  # the parser's line walker requires it
+        if isinstance(data, bytearray):
+            data += b"\n"  # in place, no copy of a multi-GB buffer
+        else:
+            data = data + b"\n"  # the parser's line walker requires it
+    if isinstance(data, bytearray):
+        # zero-copy view for the POINTER(c_char) params (bytes objects
+        # pass as-is)
+        cbuf = (ctypes.c_char * len(data)).from_buffer(data)
+    else:
+        cbuf = data
     if n_features is None:
         n_rows = ctypes.c_int64()
         max_index = ctypes.c_int64()
-        lib.ft_svmlight_scan(data, len(data), ctypes.byref(n_rows),
+        lib.ft_svmlight_scan(cbuf, len(data), ctypes.byref(n_rows),
                              ctypes.byref(max_index))
         n, f = int(n_rows.value), int(max_index.value)
     else:
         # known width: the cheap line count, no scan tokenization
-        n, f = int(lib.ft_svmlight_count(data, len(data))), \
+        n, f = int(lib.ft_svmlight_count(cbuf, len(data))), \
             int(n_features)
     labels = np.empty(n, np.float32)
     dense = np.empty((n, f), np.float32)
-    rc = lib.ft_svmlight_parse(data, len(data), f, labels,
+    rc = lib.ft_svmlight_parse(cbuf, len(data), f, labels,
                                dense.reshape(-1), num_threads)
     if rc != 0:
         raise ValueError(

@@ -144,8 +144,15 @@ def expand(args) -> list:
 
 
 def main(argv=None):
-    args = build_parser().parse_args(argv)
-    full = expand(args)
+    # unknown --long flags pass through to the full CLI verbatim (the
+    # short flags cover run_mpi.py's surface; anything else — e.g.
+    # --dirichlet true — belongs to fedtorch_tpu.cli's richer parser,
+    # which still rejects genuinely unknown names)
+    args, extra = build_parser().parse_known_args(argv)
+    if extra and not extra[0].startswith("--"):
+        build_parser().error(
+            f"unrecognized arguments: {' '.join(extra)}")
+    full = expand(args) + extra
     print("Running fedtorch_tpu.cli with:\n  " + " ".join(full))
     if args.dry_run:
         return full

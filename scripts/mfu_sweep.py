@@ -23,9 +23,12 @@ MFU for each:
                 benchmark model, not the engine)
 
 MFU accounting: per-local-step FLOPs come from XLA's cost analysis of
-the compiled fwd+bwd (each row's ``flops_source`` says so — exact for
+the compiled fwd+bwd of the ``conv_impl='conv'`` lowering — the
+algorithmic work — for EVERY row, so matmul-conv rows don't count
+im2col patch extraction as useful FLOPs and mfu_pct is comparable
+across the conv A/B (each row's ``flops_source`` says so — exact for
 any arch, includes norms/elementwise, memoized per
-(arch, batch, dtype, conv_impl)); when the backend reports none,
+(arch, batch, dtype)); when the backend reports none,
 resnet20 rows fall back to bench.py's analytic constant (fwd =
 40.8e6 MACs/image, train step = 3x fwd, 2 FLOPs/MAC) and other archs
 report timing without an MFU. Peak via BENCH_PEAK_TFLOPS (default
@@ -82,7 +85,8 @@ def measured_flops_per_step(model, batch, cache_key=None):
     resnet20 constant). None when the backend doesn't report flops
     (any failure is absorbed — a lost FLOPs count must never lose the
     config's timing). Memoized on ``cache_key`` so grid configs that
-    share (arch, batch, dtype, conv_impl) pay one compile."""
+    share (arch, batch, dtype) pay one compile (callers always pass
+    the conv-lowering model, whatever the timed row's conv_impl)."""
     if cache_key is not None and cache_key in _FLOPS_CACHE:
         return _FLOPS_CACHE[cache_key]
     import jax
@@ -180,9 +184,20 @@ def run_config(name, *, batch, dtype="bfloat16", unroll=1,
     # FLOPs per local step: XLA cost analysis of the compiled fwd+bwd
     # when available (exact for ANY arch), else the analytic resnet20
     # constant; configs with neither report no MFU rather than a made-up
-    # one.
+    # one. The numerator is ALGORITHMIC work — always counted from the
+    # conv_impl='conv' lowering, so matmul rows don't book im2col
+    # patch-extraction's extra executed FLOPs (~25-55% per 3x3 stage)
+    # as useful work and mfu_pct stays apples-to-apples across the
+    # conv A/B (the wall-clock columns are the A/B; ADVICE r4).
+    if conv_impl == "conv":
+        flops_model = model
+    else:
+        import dataclasses
+        flops_cfg = dataclasses.replace(
+            cfg, model=dataclasses.replace(cfg.model, conv_impl="conv"))
+        flops_model = define_model(flops_cfg, batch_size=batch)
     step_flops = measured_flops_per_step(
-        model, batch, cache_key=(arch, batch, dtype, conv_impl))
+        flops_model, batch, cache_key=(arch, batch, dtype))
     flops_src = "xla_cost_analysis"
     if step_flops is None:
         if arch == "resnet20":
@@ -205,6 +220,7 @@ def run_config(name, *, batch, dtype="bfloat16", unroll=1,
     if step_flops:
         achieved = steps_per_sec * step_flops
         mfu_pct = round(100 * achieved / (peak_tflops * 1e12), 2)
+        row["flops_per_step"] = step_flops
         row["achieved_tflops"] = round(achieved / 1e12, 3)
         row["mfu_pct"] = mfu_pct
     log(f"{name:12s}: {steps_per_sec:8.2f} steps/s/chip  "

@@ -45,13 +45,25 @@ def _slow_nodeids():
         return set()
 
 
+def _advise(config, msg):
+    """Print an advisory without the warnings machinery: under a
+    project/user ``filterwarnings = error`` a collection-time
+    ``warnings.warn`` would abort collection of the whole suite, and a
+    degraded fast lane must never cost the full one."""
+    import sys
+    tr = config.pluginmanager.get_plugin("terminalreporter")
+    if tr is not None:
+        tr.write_line("conftest: " + msg, yellow=True)
+    else:
+        print("conftest: " + msg, file=sys.stderr)
+
+
 def pytest_collection_modifyitems(config, items):
-    import warnings
     slow = _slow_nodeids()
     if not slow:
-        warnings.warn("tests/slow_tests.txt missing or empty — the "
-                      "fast lane (-m 'not slow') will run slow tests; "
-                      "regenerate with scripts/tier_tests.py")
+        _advise(config, "tests/slow_tests.txt missing or empty — the "
+                "fast lane (-m 'not slow') will run slow tests; "
+                "regenerate with scripts/tier_tests.py")
         return
     matched = set()
     for item in items:
@@ -72,7 +84,7 @@ def pytest_collection_modifyitems(config, items):
     unmatched = {s for s in slow - matched
                  if s.split("::", 1)[0] in collected_files}
     if unmatched:
-        warnings.warn(f"{len(unmatched)} entries in tests/slow_tests.txt "
-                      "match no collected test (stale after a rename?); "
-                      "regenerate with scripts/tier_tests.py: "
-                      + ", ".join(sorted(unmatched)[:3]) + " ...")
+        _advise(config, f"{len(unmatched)} entries in tests/slow_tests.txt "
+                "match no collected test (stale after a rename?); "
+                "regenerate with scripts/tier_tests.py: "
+                + ", ".join(sorted(unmatched)[:3]) + " ...")
