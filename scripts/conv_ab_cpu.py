@@ -76,13 +76,16 @@ def main() -> int:
                 row.pop(key, None)
             rows.append(row)
 
-    # pair up the A/Bs
+    # pair up the A/Bs; the ratio comes from the UNROUNDED timed
+    # segments (same step count per batch config), not the 2-decimal
+    # steps/s display values, which quantize to +-20-40% at these
+    # magnitudes
     by = {(r["batch"], r["conv_impl"]): r for r in rows}
     speedups = {}
     for batch in (50, 128):
-        conv = by[(batch, "conv")]["local_steps_per_sec_per_chip"]
-        mm = by[(batch, "matmul")]["local_steps_per_sec_per_chip"]
-        speedups[f"matmul_vs_conv_b{batch}"] = round(mm / conv, 2)
+        conv_t = by[(batch, "conv")]["timed_s"]
+        mm_t = by[(batch, "matmul")]["timed_s"]
+        speedups[f"matmul_vs_conv_b{batch}"] = round(conv_t / mm_t, 2)
 
     record = {
         "metric": "conv_lowering_ab_xla_cpu",

@@ -70,8 +70,20 @@ run() {
     [ $rc -ne 0 ] && FAILED=1
 }
 
-run python bench.py                              # north star -> TPU_BENCH_CAPTURE.json FIRST
-run env BENCH_CONV_IMPL=matmul python bench.py   # conv-lowering A/B on the north star
+run python bench.py                              # north star (matmul default) -> TPU_BENCH_CAPTURE.json FIRST
+# grouped-conv side of the lowering A/B — teed to a named artifact so
+# the scarce window isn't spent on a record that only lives in this log
+echo "=== conv-side bench A/B -> BENCH_CONVSIDE_AB.json ==="
+BENCH_PROBE_TRIES=2 env BENCH_CONV_IMPL=conv python bench.py \
+    | tee BENCH_CONVSIDE_AB.json
+conv_rc=${PIPESTATUS[0]}
+if [ "$conv_rc" -ne 0 ] \
+        || grep -q "CPU fallback" BENCH_CONVSIDE_AB.json; then
+    # no partial or relay-wedged CPU record under an on-chip filename
+    rm -f BENCH_CONVSIDE_AB.json
+    FAILED=1
+fi
+echo "=== rc=$conv_rc ==="
 run python scripts/mfu_sweep.py                  # -> MFU_SWEEP.json (lever grid)
 run python scripts/vmap_penalty_bench.py         # -> VMAP_PENALTY.json (conv A/B detail)
 run python scripts/moe_ab_bench.py               # -> MOE_AB.json (dense vs sparse dispatch)

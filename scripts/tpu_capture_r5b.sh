@@ -1,0 +1,45 @@
+#!/bin/bash
+# Round-5 stage 2: after the main chain (tpu_capture_r5.sh) finishes,
+# capture the north-star ACCURACY-vs-WALL-CLOCK curves on the chip
+# (VERDICT r4 item #7 — BASELINE.json's metric is wall-clock to target
+# accuracy, and no on-chip curve exists; at round-2 throughput the
+# 100-round fedavg + scaffold curves are ~minutes each). Probes once
+# with short patience: if the relay died again after the main capture,
+# the CPU-branch curves stand.
+#     nohup bash scripts/tpu_capture_r5b.sh > /tmp/tpu_capture_r5b.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.." || exit 1
+
+while pgrep -f "bash scripts/tpu_capture_r5.sh" > /dev/null; do
+    sleep 120
+done
+echo "[tpu_capture_r5b] main chain done — probing"
+
+BENCH_PROBE_TRIES=3 python - <<'EOF'
+import sys
+sys.path.insert(0, ".")
+from bench import probe_device
+sys.exit(0 if probe_device() else 1)
+EOF
+if [ $? -ne 0 ]; then
+    echo "[tpu_capture_r5b] relay dead; on-chip curves not captured"
+    exit 1
+fi
+
+echo "[tpu_capture_r5b] relay alive — capturing curves"
+FAILED=0
+run_curve() {
+    local out="$1"; shift
+    echo "=== $* -> $out ==="
+    BENCH_PROBE_TRIES=2 "$@" > "$out.tmp" && mv "$out.tmp" "$out"
+    local rc=$?
+    echo "=== rc=$rc ==="
+    [ $rc -ne 0 ] && FAILED=1
+}
+
+run_curve NORTHSTAR_CURVE_FEDAVG.json \
+    python scripts/northstar_synthetic.py --rounds 100
+run_curve NORTHSTAR_CURVE_SCAFFOLD.json \
+    python scripts/northstar_synthetic.py --rounds 100 --algorithm scaffold
+echo "[tpu_capture_r5b] done (failed=$FAILED)"
+exit $FAILED

@@ -1,0 +1,46 @@
+#!/bin/bash
+# Round-5 stage 3: after the curve stage, run the grouped-conv side of
+# the bench-level lowering A/B. The shipped default is now the im2col
+# matmul lowering (conv_impl='auto' — models/__init__.py
+# resolve_conv_impl), so the main chain's default bench.py run measures
+# matmul and this records the conv side for the on-chip speedup table.
+#     nohup bash scripts/tpu_capture_r5c.sh > /tmp/tpu_capture_r5c.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.." || exit 1
+
+while pgrep -f "bash scripts/tpu_capture_r5.sh" > /dev/null \
+      || pgrep -f "bash scripts/tpu_capture_r5b.sh" > /dev/null; do
+    sleep 120
+done
+if [ -s BENCH_CONVSIDE_AB.json ] \
+        && ! grep -q "CPU fallback" BENCH_CONVSIDE_AB.json; then
+    echo "[tpu_capture_r5c] conv side already captured by the main "\
+"chain; nothing to do"
+    exit 0
+fi
+echo "[tpu_capture_r5c] prior stages done — probing"
+
+BENCH_PROBE_TRIES=3 python - <<'EOF'
+import sys
+sys.path.insert(0, ".")
+from bench import probe_device
+sys.exit(0 if probe_device() else 1)
+EOF
+if [ $? -ne 0 ]; then
+    echo "[tpu_capture_r5c] relay dead; conv-side A/B not captured"
+    exit 1
+fi
+
+echo "[tpu_capture_r5c] relay alive — conv-side bench A/B"
+BENCH_PROBE_TRIES=2 env BENCH_CONV_IMPL=conv python bench.py \
+    | tee BENCH_CONVSIDE_AB.json
+rc=${PIPESTATUS[0]}  # bench's status, not tee's
+if [ "$rc" -ne 0 ] \
+        || grep -q "CPU fallback" BENCH_CONVSIDE_AB.json; then
+    # bench exits 0 on relay fallback; a wedged-relay CPU record must
+    # not sit under an on-chip A/B filename either
+    rm -f BENCH_CONVSIDE_AB.json
+    rc=1
+fi
+echo "[tpu_capture_r5c] done rc=$rc"
+exit $rc

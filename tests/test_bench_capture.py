@@ -56,6 +56,7 @@ def _stamp(bench, **over):
         "captured_unix": int(time.time()) - 3600,
         "device": "TPU_0(process=0,(0,0,0,0))",
         "git_head": _head(),
+        "bench_knobs": bench.resolved_bench_knobs(),
     }
     rec.update(over)
     with open(bench.TPU_CAPTURE_PATH, "w") as f:
@@ -149,6 +150,7 @@ class TestDefaultConfigPersistGate:
         assert bench.is_default_bench_config()
 
     @pytest.mark.parametrize("knob,value", [
+        ("BENCH_CONV_IMPL", "conv"),
         ("BENCH_CONV_IMPL", "matmul"),
         ("BENCH_DTYPE", "float32"),
         ("BENCH_SCAN_UNROLL", "4"),
@@ -160,7 +162,7 @@ class TestDefaultConfigPersistGate:
         assert not bench.is_default_bench_config()
 
     @pytest.mark.parametrize("knob,value", [
-        ("BENCH_CONV_IMPL", "conv"),
+        ("BENCH_CONV_IMPL", "auto"),
         ("BENCH_DTYPE", "bfloat16"),
         ("BENCH_SCAN_UNROLL", "1"),
         ("BENCH_SINGLE_DISPATCH", "1"),
@@ -169,3 +171,38 @@ class TestDefaultConfigPersistGate:
                                              knob, value):
         monkeypatch.setenv(knob, value)
         assert bench.is_default_bench_config()
+
+
+class TestKnobProvenance:
+    """A replayed capture must have measured the same compiled program
+    this run would (code-review round 5): resolved-knob stamps are
+    required and must match, so e.g. a pre-conv-flip grouped-conv
+    capture can never stand in for the post-flip matmul default."""
+
+    def test_matching_knobs_accepted(self, bench):
+        _stamp(bench)
+        assert bench._load_fresh_capture(0.5) is not None
+
+    def test_mismatched_knobs_refused(self, bench):
+        knobs = bench.resolved_bench_knobs()
+        knobs["BENCH_CONV_IMPL"] = (
+            "conv" if knobs["BENCH_CONV_IMPL"] != "conv" else "matmul")
+        _stamp(bench, bench_knobs=knobs)
+        assert bench._load_fresh_capture(0.5) is None
+
+    def test_missing_knob_stamp_refused(self, bench):
+        rec = _stamp(bench)
+        del rec["bench_knobs"]
+        with open(bench.TPU_CAPTURE_PATH, "w") as f:
+            json.dump(rec, f)
+        assert bench._load_fresh_capture(0.5) is None
+
+    def test_resolved_knobs_resolve_auto(self, bench, monkeypatch):
+        for k in ("BENCH_CONV_IMPL", "BENCH_DTYPE",
+                  "BENCH_SCAN_UNROLL", "BENCH_SINGLE_DISPATCH"):
+            monkeypatch.delenv(k, raising=False)
+        knobs = bench.resolved_bench_knobs()
+        # the default 'auto' must be resolved to a concrete lowering
+        assert knobs["BENCH_CONV_IMPL"] in ("conv", "matmul")
+        monkeypatch.setenv("BENCH_CONV_IMPL", "conv")
+        assert bench.resolved_bench_knobs()["BENCH_CONV_IMPL"] == "conv"
