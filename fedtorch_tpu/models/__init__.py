@@ -173,6 +173,16 @@ def define_model(cfg: ExperimentConfig, batch_size: int = 2) -> ModelDef:
         # head count must divide the width; degrade gracefully for odd
         # hidden sizes instead of crashing in attention
         num_heads = next(h for h in (4, 2, 1) if d_model % h == 0)
+        if m.moe_experts >= 8 and m.moe_capacity_factor == 0:
+            import warnings
+            warnings.warn(
+                f"--moe_experts {m.moe_experts} with dense dispatch "
+                f"executes {m.moe_experts}x the expert-MLP FLOPs "
+                "(exactness-oracle mode). For training at scale set "
+                "--moe_capacity_factor 1.25: measured 8.6x fewer "
+                "executed FLOPs at E=16 with bounded token drop "
+                "(docs/performance.md 'Dispatch A/B', MOE_AB_CPU.json)",
+                stacklevel=2)
         module = TransformerLM(vocab_size=m.vocab_size, d_model=d_model,
                                num_heads=num_heads,
                                num_layers=m.mlp_num_layers,

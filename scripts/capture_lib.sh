@@ -1,0 +1,39 @@
+# Shared helpers for the round-5 capture chain. Source, don't execute.
+#
+# Stage ordering uses DONE-SENTINEL files, not pgrep: a pgrep poll
+# reads "predecessor not started yet" as "finished" and would let two
+# stages probe the single-session relay concurrently (the documented
+# wedge trigger). Each stage traps EXIT to touch its sentinel; the
+# launcher removes stale sentinels before starting a fresh chain.
+
+R5_DONE=/tmp/tpu_capture_r5.done
+R5B_DONE=/tmp/tpu_capture_r5b.done
+
+wait_for_done() {
+    while [ ! -f "$1" ]; do
+        sleep 120
+    done
+}
+
+capture_conv_side() {
+    # grouped-conv side of the lowering A/B -> BENCH_CONVSIDE_AB.json.
+    # Rejects a partial record (nonzero bench status) AND a
+    # relay-wedged CPU-fallback record (bench exits 0 on fallback) —
+    # neither may sit under an on-chip A/B filename.
+    echo "=== conv-side bench A/B -> BENCH_CONVSIDE_AB.json ==="
+    BENCH_PROBE_TRIES=2 env BENCH_CONV_IMPL=conv python bench.py \
+        | tee BENCH_CONVSIDE_AB.json
+    local rc=${PIPESTATUS[0]}
+    if [ "$rc" -ne 0 ] \
+            || grep -q "CPU fallback" BENCH_CONVSIDE_AB.json; then
+        rm -f BENCH_CONVSIDE_AB.json
+        rc=1
+    fi
+    echo "=== conv-side rc=$rc ==="
+    return "$rc"
+}
+
+conv_side_captured() {
+    [ -s BENCH_CONVSIDE_AB.json ] \
+        && ! grep -q "CPU fallback" BENCH_CONVSIDE_AB.json
+}

@@ -94,7 +94,9 @@ def main() -> int:
                    "backend; no MXU — ratios are evidence, not the "
                    "on-chip decision (see scripts/tpu_capture_r5.sh "
                    "queue). FLOPs numerator is the conv lowering's "
-                   "cost analysis for every row."),
+                   "cost analysis for every row. Speedups are ratios "
+                   "of the unrounded timed segments (identical step "
+                   "counts per batch)."),
         "rows": rows,
         "speedups": speedups,
         "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",

@@ -16,6 +16,8 @@
 #     nohup bash scripts/tpu_capture_r5.sh > /tmp/tpu_capture_r5.log 2>&1 &
 set -u
 cd "$(dirname "$0")/.." || exit 1
+. scripts/capture_lib.sh
+trap 'touch "$R5_DONE"' EXIT
 
 # The launch time only bounds the HARD end (stay clear of the driver's
 # round-end bench, ~12 h after the round starts); the probe budget
@@ -71,19 +73,7 @@ run() {
 }
 
 run python bench.py                              # north star (matmul default) -> TPU_BENCH_CAPTURE.json FIRST
-# grouped-conv side of the lowering A/B — teed to a named artifact so
-# the scarce window isn't spent on a record that only lives in this log
-echo "=== conv-side bench A/B -> BENCH_CONVSIDE_AB.json ==="
-BENCH_PROBE_TRIES=2 env BENCH_CONV_IMPL=conv python bench.py \
-    | tee BENCH_CONVSIDE_AB.json
-conv_rc=${PIPESTATUS[0]}
-if [ "$conv_rc" -ne 0 ] \
-        || grep -q "CPU fallback" BENCH_CONVSIDE_AB.json; then
-    # no partial or relay-wedged CPU record under an on-chip filename
-    rm -f BENCH_CONVSIDE_AB.json
-    FAILED=1
-fi
-echo "=== rc=$conv_rc ==="
+capture_conv_side || FAILED=1                    # grouped-conv A/B side -> BENCH_CONVSIDE_AB.json
 run python scripts/mfu_sweep.py                  # -> MFU_SWEEP.json (lever grid)
 run python scripts/vmap_penalty_bench.py         # -> VMAP_PENALTY.json (conv A/B detail)
 run python scripts/moe_ab_bench.py               # -> MOE_AB.json (dense vs sparse dispatch)

@@ -9,10 +9,13 @@
 #     nohup bash scripts/tpu_capture_r5b.sh > /tmp/tpu_capture_r5b.log 2>&1 &
 set -u
 cd "$(dirname "$0")/.." || exit 1
+. scripts/capture_lib.sh
+trap 'touch "$R5B_DONE"' EXIT
 
-while pgrep -f "bash scripts/tpu_capture_r5.sh" > /dev/null; do
-    sleep 120
-done
+# done-sentinel, not pgrep: a pgrep poll reads "r5 not started yet"
+# as "finished" and would probe concurrently with it (launch-order
+# race — the relay is single-session)
+wait_for_done "$R5_DONE"
 echo "[tpu_capture_r5b] main chain done — probing"
 
 BENCH_PROBE_TRIES=3 python - <<'EOF'

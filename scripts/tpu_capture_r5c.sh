@@ -7,13 +7,10 @@
 #     nohup bash scripts/tpu_capture_r5c.sh > /tmp/tpu_capture_r5c.log 2>&1 &
 set -u
 cd "$(dirname "$0")/.." || exit 1
+. scripts/capture_lib.sh
 
-while pgrep -f "bash scripts/tpu_capture_r5.sh" > /dev/null \
-      || pgrep -f "bash scripts/tpu_capture_r5b.sh" > /dev/null; do
-    sleep 120
-done
-if [ -s BENCH_CONVSIDE_AB.json ] \
-        && ! grep -q "CPU fallback" BENCH_CONVSIDE_AB.json; then
+wait_for_done "$R5B_DONE"  # sentinel ordering: see capture_lib.sh
+if conv_side_captured; then
     echo "[tpu_capture_r5c] conv side already captured by the main "\
 "chain; nothing to do"
     exit 0
@@ -31,16 +28,8 @@ if [ $? -ne 0 ]; then
     exit 1
 fi
 
-echo "[tpu_capture_r5c] relay alive — conv-side bench A/B"
-BENCH_PROBE_TRIES=2 env BENCH_CONV_IMPL=conv python bench.py \
-    | tee BENCH_CONVSIDE_AB.json
-rc=${PIPESTATUS[0]}  # bench's status, not tee's
-if [ "$rc" -ne 0 ] \
-        || grep -q "CPU fallback" BENCH_CONVSIDE_AB.json; then
-    # bench exits 0 on relay fallback; a wedged-relay CPU record must
-    # not sit under an on-chip A/B filename either
-    rm -f BENCH_CONVSIDE_AB.json
-    rc=1
-fi
+echo "[tpu_capture_r5c] relay alive — backfilling the conv side"
+capture_conv_side
+rc=$?
 echo "[tpu_capture_r5c] done rc=$rc"
 exit $rc

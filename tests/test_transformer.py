@@ -131,3 +131,31 @@ def test_long_context_ring_matches_dense():
     ring = long_context_apply(model.module, params, toks, mesh)
     np.testing.assert_allclose(np.asarray(dense), np.asarray(ring),
                                atol=3e-4, rtol=3e-4)
+
+
+def test_large_e_dense_dispatch_warns():
+    """E>=8 with dense dispatch is oracle mode at Ex the FLOPs; the
+    factory nudges toward the measured sparse recommendation
+    (MOE_AB_CPU.json: 8.6x executed-FLOPs ratio at E=16)."""
+    import warnings
+
+    from fedtorch_tpu.config import (
+        DataConfig, ExperimentConfig, ModelConfig,
+    )
+    from fedtorch_tpu.models import define_model
+    cfg = ExperimentConfig(
+        data=DataConfig(dataset="shakespeare", batch_size=2),
+        model=ModelConfig(arch="transformer", moe_experts=8)).finalize()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        define_model(cfg, batch_size=2)
+    assert any("moe_capacity_factor" in str(x.message) for x in w)
+    # sparse dispatch silences it
+    cfg2 = ExperimentConfig(
+        data=DataConfig(dataset="shakespeare", batch_size=2),
+        model=ModelConfig(arch="transformer", moe_experts=8,
+                          moe_capacity_factor=1.25)).finalize()
+    with warnings.catch_warnings(record=True) as w2:
+        warnings.simplefilter("always")
+        define_model(cfg2, batch_size=2)
+    assert not any("moe_capacity_factor" in str(x.message) for x in w2)
