@@ -56,10 +56,12 @@ def log(*a):
 # for both the measurement read sites below and the capture gate, so a
 # default changed in one place cannot silently desynchronize the other.
 BENCH_AB_KNOBS = {
-    # 'auto' = the SHIPPED default lowering (resolves to matmul for the
-    # north-star resnet20/cifar10 — models/__init__.py
-    # resolve_conv_impl); BENCH_CONV_IMPL=conv runs the grouped-conv
-    # side of the A/B
+    # 'auto' = the SHIPPED default lowering (backend-aware — resolves
+    # to native conv on TPU for the north-star resnet20/cifar10;
+    # models/__init__.py resolve_conv_impl). BENCH_CONV_IMPL=matmul
+    # runs the im2col variant side of the on-chip A/B
+    # (BENCH_MATMULSIDE_AB.json); BENCH_CONV_IMPL=conv on a CPU host
+    # pins the non-default lowering there.
     "BENCH_CONV_IMPL": "auto",
     "BENCH_DTYPE": "bfloat16",
     "BENCH_SCAN_UNROLL": "1",
@@ -77,30 +79,44 @@ NORTH_STAR_ARCH = "resnet20"
 NORTH_STAR_DATASET = "cifar10"
 
 
+def _resolve_knobs(knobs: dict) -> dict:
+    """Resolve a knob dict to the program identity it measures, pinned
+    to ``backend='tpu'``: the north-star metric IS the TPU program —
+    the capture is stamped on-chip, and the wedged-relay replay gate
+    re-computes this identity on a box whose live backend is CPU, so
+    resolving with the live backend would spuriously refuse every
+    capture now that 'auto' is backend-aware (conv on TPU, matmul on
+    CPU). Single source for resolved_bench_knobs AND the persist gate
+    — they must never desynchronize."""
+    knobs = dict(knobs)
+    if knobs["BENCH_CONV_IMPL"] == "auto":
+        from fedtorch_tpu.models import resolve_conv_impl
+        knobs["BENCH_CONV_IMPL"] = resolve_conv_impl(
+            "auto", NORTH_STAR_ARCH, NORTH_STAR_DATASET, backend="tpu")
+    return knobs
+
+
 def resolved_bench_knobs() -> dict:
     """The A/B knobs with BENCH_CONV_IMPL resolved through the model
     registry's 'auto' rule — the program identity a capture measures.
     Two configs with equal resolved knobs compile the same program,
     even across a default flip that renames 'auto''s meaning."""
-    knobs = {k: ab_knob(k) for k in BENCH_AB_KNOBS}
-    if knobs["BENCH_CONV_IMPL"] == "auto":
-        from fedtorch_tpu.models import resolve_conv_impl
-        knobs["BENCH_CONV_IMPL"] = resolve_conv_impl(
-            "auto", NORTH_STAR_ARCH, NORTH_STAR_DATASET)
-    return knobs
+    return _resolve_knobs({k: ab_knob(k) for k in BENCH_AB_KNOBS})
 
 
 def is_default_bench_config() -> bool:
-    """True when no A/B env knob deviates from the north-star default.
+    """True when this run measures the north-star PROGRAM.
 
-    Only a default-config run may persist the replayable capture
+    Only such a run may persist the replayable capture
     (TPU_BENCH_CAPTURE.json): a variant (conv lowering, dtype, unroll,
     dispatch mode) answers a different question than the metric name
     claims, and a relay wedge between a variant run and an end-of-queue
     re-persist would leave the variant number masquerading as the
-    north-star record."""
-    return all(ab_knob(knob) == dflt
-               for knob, dflt in BENCH_AB_KNOBS.items())
+    north-star record. The comparison is on RESOLVED knob identities,
+    not raw env strings: an explicit knob equal to what 'auto' resolves
+    to (e.g. BENCH_CONV_IMPL=conv on TPU post-flip) compiles the
+    identical program and its capture is just as replayable."""
+    return resolved_bench_knobs() == _resolve_knobs(BENCH_AB_KNOBS)
 
 
 def probe_device(timeout_s: int = 120) -> bool:
@@ -239,9 +255,20 @@ def main():
             online_client_rate=ONLINE_RATE, algorithm="fedavg",
             sync_type="local_step"),
         # BENCH_CONV_IMPL=matmul A/Bs the im2col conv lowering
-        # (docs/performance.md "MFU roofline")
-        model=ModelConfig(arch=NORTH_STAR_ARCH,
-                          conv_impl=ab_knob("BENCH_CONV_IMPL")),
+        # (docs/performance.md "MFU roofline"). A device run resolves
+        # the knob through the same TPU-pinned rule the capture stamp
+        # uses, so the measured program and its stamped identity
+        # cannot diverge even on a host whose live backend would
+        # resolve 'auto' differently (e.g. a plain CPU box where
+        # probe_device() succeeds). The CPU fallback keeps live-backend
+        # resolution instead: it never persists a capture, and forcing
+        # the TPU-resolved grouped conv onto XLA CPU would turn the
+        # seconds-long liveness probe into a multi-minute compile
+        # (CONV_AB_CPU.json: up to 787 s compile, ~7x slower steps).
+        model=ModelConfig(
+            arch=NORTH_STAR_ARCH,
+            conv_impl=ab_knob("BENCH_CONV_IMPL") if fallback_cpu
+            else resolved_bench_knobs()["BENCH_CONV_IMPL"]),
         optim=OptimConfig(lr=0.1, in_momentum=True),
         train=TrainConfig(local_step=LOCAL_STEPS),
         # BENCH_SCAN_UNROLL>1 lets XLA software-pipeline consecutive
@@ -364,7 +391,8 @@ def main():
         stamp["git_head"] = _git_head()
         # what the knobs RESOLVED to at capture time: a replay must
         # only stand in for a run that would measure the same program
-        # (e.g. a pre-conv-flip capture must not replay post-flip)
+        # (e.g. a capture from before a lowering-default change must
+        # not replay after it)
         stamp["bench_knobs"] = resolved_bench_knobs()
         with open(TPU_CAPTURE_PATH, "w") as f:
             json.dump(stamp, f, indent=1)
@@ -412,9 +440,10 @@ def _load_fresh_capture(cpu_steps_per_sec: float):
                 "too stale to report; using the CPU record")
             return None
         # the capture must have measured the same program this run
-        # would: refuse on missing or mismatched resolved knobs (a
-        # pre-conv-flip 'conv' capture must not stand in for the
-        # post-flip matmul default under the same metric name)
+        # would: refuse on missing or mismatched resolved knobs (e.g.
+        # a capture taken under the pre-reversal matmul default must
+        # not stand in for today's native-conv default under the same
+        # metric name)
         cap_knobs = stamp.get("bench_knobs")
         cur_knobs = resolved_bench_knobs()
         if cap_knobs != cur_knobs:

@@ -53,22 +53,40 @@ def _sample_regression(dataset: str, batch: int, synthetic_dim: int):
 _CONV_FAMILIES = ("resnet", "wideresnet", "densenet", "cnn")
 
 
-def resolve_conv_impl(conv_impl: str, arch: str, dataset: str) -> str:
-    """Resolve ``conv_impl='auto'`` per (arch, dataset).
+def resolve_conv_impl(conv_impl: str, arch: str, dataset: str,
+                      backend: "str | None" = None) -> str:
+    """Resolve ``conv_impl='auto'`` per (backend, arch, dataset).
 
-    The im2col batched-matmul lowering wins on the small-image conv
-    families — 7.0-8.2x over grouped conv on XLA-compiled identical
-    round programs at batch 50/128 (CONV_AB_CPU.json, round 5), and
-    the MXU N-lane roofline predicts a LARGER win on-chip, where the
-    per-client grouped conv tiles each client's small matmul
-    separately (docs/performance.md "MFU roofline"; on-chip sweep
-    queued in scripts/tpu_capture_r5.sh remains the final authority).
-    Above ~64 px inputs the kh*kw x patch HBM/memory trade flips the
-    economics (a 7x7 stem books 49x its activations), so larger-image
-    datasets keep XLA's native convolution."""
+    Both sides of the lowering A/B have now been measured on the same
+    compiled federated round program, and the two backends disagree:
+
+    - **TPU v5e (on-chip, round 5)**: grouped conv wins **5.06x** —
+      579.15 vs 114.4 local-steps/s on the north-star bench
+      (BENCH_CONVSIDE_AB.json vs BENCH_MATMULSIDE_AB.json, 2026-07-31).
+      The MXU roofline's predicted matmul win did NOT transfer: the
+      kh*kw x patch HBM traffic (9x activations for 3x3 convs)
+      dominates on-chip, where XLA's native conv emitter already
+      tiles well.
+    - **XLA CPU**: im2col batched matmul wins **7.0-8.2x** at batch
+      50/128 (CONV_AB_CPU.json, round 5).
+
+    So 'auto' keeps XLA's native convolution on accelerators and uses
+    the im2col matmul lowering only on the CPU backend for the
+    small-image conv families (<=64 px — above that the patch-memory
+    trade is prohibitive even on CPU: a 7x7 stem books 49x its
+    activations). ``backend=None`` reads the live
+    ``jax.default_backend()``; pass it explicitly to resolve for a
+    target platform other than the current one (bench.py resolves the
+    north-star capture identity with ``backend='tpu'``).
+    Decision table: docs/performance.md "Conv-lowering decision"."""
     if conv_impl != "auto":
         return conv_impl
     if not arch.startswith(_CONV_FAMILIES):
+        return "conv"
+    if backend is None:
+        import jax
+        backend = jax.default_backend()
+    if backend != "cpu":
         return "conv"
     try:
         h, w = image_shape(dataset)[:2]

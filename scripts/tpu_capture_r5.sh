@@ -73,7 +73,7 @@ run() {
 }
 
 run python bench.py                              # north star (matmul default) -> TPU_BENCH_CAPTURE.json FIRST
-capture_conv_side || FAILED=1                    # grouped-conv A/B side -> BENCH_CONVSIDE_AB.json
+capture_conv_side || FAILED=1                    # non-default lowering side (matmul post-flip) -> BENCH_MATMULSIDE_AB.json
 run python scripts/mfu_sweep.py                  # -> MFU_SWEEP.json (lever grid)
 run python scripts/vmap_penalty_bench.py         # -> VMAP_PENALTY.json (conv A/B detail)
 run python scripts/moe_ab_bench.py               # -> MOE_AB.json (dense vs sparse dispatch)

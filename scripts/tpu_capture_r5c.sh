@@ -1,9 +1,10 @@
 #!/bin/bash
-# Round-5 stage 3: after the curve stage, run the grouped-conv side of
-# the bench-level lowering A/B. The shipped default is now the im2col
-# matmul lowering (conv_impl='auto' — models/__init__.py
-# resolve_conv_impl), so the main chain's default bench.py run measures
-# matmul and this records the conv side for the on-chip speedup table.
+# Round-5 stage 3: after the curve stage, backfill the NON-DEFAULT
+# side of the bench-level lowering A/B if the main chain didn't get
+# to it. The shipped default 'auto' is backend-aware (native conv on
+# TPU: models/__init__.py resolve_conv_impl, reversed on-chip in
+# round 5), so the main chain's default bench.py run measures grouped
+# conv and this records the im2col matmul side for the speedup table.
 #     nohup bash scripts/tpu_capture_r5c.sh > /tmp/tpu_capture_r5c.log 2>&1 &
 set -u
 cd "$(dirname "$0")/.." || exit 1
