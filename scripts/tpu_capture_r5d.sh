@@ -1,0 +1,83 @@
+#!/bin/bash
+# Round-5 stage 4 (recovery): the container restarted mid-round and
+# killed the r5/r5b/r5c chain after its first stages landed (bench,
+# conv A/B both sides, MFU sweep, vmap penalty, MoE A/B). This stage
+# runs ONLY what the crash left un-captured, in information-value
+# order: flash-under-Mosaic (VERDICT r4 #4), the flash training A/B,
+# the zoo refresh (TPU_ZOO.json is still the round-2 19-case run),
+# the on-chip accuracy-vs-wall-clock curves (VERDICT r4 #7), the
+# baseline suite, and a final bench re-persist at the current head.
+#
+# Single-session relay discipline (same as tpu_capture_r5.sh): strict
+# serial execution, never wrap a relay-touching run in `timeout`.
+#     nohup bash scripts/tpu_capture_r5d.sh > /tmp/tpu_capture_r5d.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.." || exit 1
+. scripts/capture_lib.sh
+R5D_DONE=/tmp/tpu_capture_r5d.done
+trap 'touch "$R5D_DONE"' EXIT
+
+# If any earlier-stage script somehow survived the restart, defer.
+while pgrep -f "bash scripts/tpu_capture_r5.sh" > /dev/null \
+      || pgrep -f "bash scripts/tpu_capture_r5b.sh" > /dev/null \
+      || pgrep -f "bash scripts/tpu_capture_r5c.sh" > /dev/null; do
+    sleep 120
+done
+
+LAUNCH="$(date +%s)"
+DEADLINE="${TPU_CAPTURE_DEADLINE_UNIX:-$(( LAUNCH + 32400 ))}"  # 9 h
+echo "[tpu_capture_r5d] probing until $(date -u -d "@$DEADLINE" +%H:%M:%S) UTC"
+
+GRANTED=0
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+    BENCH_PROBE_TRIES=5 python - <<'EOF'
+import sys
+sys.path.insert(0, ".")
+from bench import probe_device
+sys.exit(0 if probe_device() else 1)
+EOF
+    if [ $? -eq 0 ]; then
+        GRANTED=1
+        break
+    fi
+    echo "[tpu_capture_r5d] relay dead at $(date -u +%H:%M:%S) UTC"
+    sleep 60
+done
+
+if [ "$GRANTED" -ne 1 ]; then
+    echo "[tpu_capture_r5d] relay never recovered; nothing captured"
+    exit 1
+fi
+
+echo "[tpu_capture_r5d] relay alive — capturing remaining stages"
+FAILED=0
+run() {
+    echo "=== $* ==="
+    BENCH_PROBE_TRIES=2 "$@"
+    local rc=$?
+    echo "=== rc=$rc ==="
+    [ $rc -ne 0 ] && FAILED=1
+}
+run_to() {
+    local out="$1"; shift
+    echo "=== $* -> $out ==="
+    BENCH_PROBE_TRIES=2 "$@" > "$out.tmp" && mv "$out.tmp" "$out"
+    local rc=$?
+    echo "=== rc=$rc ==="
+    [ $rc -ne 0 ] && FAILED=1
+}
+
+run python scripts/pallas_tpu_check.py           # -> PALLAS_TPU.json (flash under real Mosaic)
+run python scripts/flash_train_bench.py          # -> FLASH_TRAIN.json
+run python scripts/tpu_zoo_check.py              # -> TPU_ZOO.json (refresh: flash/MoE/remat/matmulconv cases)
+run_to NORTHSTAR_CURVE_FEDAVG.json \
+    python scripts/northstar_synthetic.py --rounds 100
+run_to NORTHSTAR_CURVE_SCAFFOLD.json \
+    python scripts/northstar_synthetic.py --rounds 100 --algorithm scaffold
+run python scripts/baseline_suite.py             # -> BASELINE_SUITE.json
+if ! conv_side_captured; then
+    capture_conv_side || FAILED=1
+fi
+run python bench.py                              # re-persist at current head
+echo "[tpu_capture_r5d] capture done (failed=$FAILED)"
+exit $FAILED
