@@ -37,13 +37,24 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
+# Mosaic requires the last two dims of every block to be divisible by
+# (8, 128) or equal to the array dims (jax/_src/pallas/mosaic/
+# lowering.py:_check_block_mappings — validated against the real
+# lowering in round 5: a [1, block_q] lse block is REJECTED on-chip
+# even though the interpreter accepts it). Per-q-row statistics
+# therefore carry a broadcast 128-lane trailing dim, the same layout
+# production TPU flash kernels use; lane 0 is the value.
+_LANES = 128
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
                 acc_scr, *, scale: float, causal: bool):
     """One (batch·head, q-block, k-block) grid cell. The k axis is the
     innermost ('arbitrary') grid dimension: running (max, sum, acc)
     stats live in VMEM scratch across its iterations, so only ONE
     [block_k, D] K/V tile is resident at a time — true streaming, no
-    full-sequence VMEM residency."""
+    full-sequence VMEM residency. m/l scratch and the lse output are
+    [blk_q, 128] lane-broadcast (every lane equal; see _LANES)."""
     qi = pl.program_id(1)
     kb = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -69,16 +80,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
             k_pos = kb * blk_k + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 1)
             s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
-        m = m_scr[:]
-        m_blk = jnp.max(s, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m, m_blk)
+        m = m_scr[:]                                     # [blk_q, 128]
+        m_blk = jnp.max(s, axis=-1, keepdims=True)       # [blk_q, 1]
+        m_new = jnp.maximum(m, m_blk)                    # [blk_q, 128]
         m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-        p = jnp.exp(s - m_safe)
-        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        p = jnp.exp(s - m_safe[:, :1])
+        p = jnp.where(jnp.isfinite(s), p, 0.0)           # [blk_q, blk_k]
         corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
         m_scr[:] = m_new
         l_scr[:] = l_scr[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
-        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+        acc_scr[:] = acc_scr[:] * corr[:, :1] + jax.lax.dot_general(
             p, v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
@@ -92,9 +103,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
     @pl.when(kb == nk - 1)
     def _():
         l_safe = jnp.maximum(l_scr[:], 1e-30)
-        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        o_ref[0] = (acc_scr[:] / l_safe[:, :1]).astype(o_ref.dtype)
         m_fin = jnp.where(jnp.isfinite(m_scr[:]), m_scr[:], 0.0)
-        lse_ref[0] = (m_fin + jnp.log(l_safe))[:, 0]
+        lse_ref[0] = m_fin + jnp.log(l_safe)             # [blk_q, 128]
 
 
 def _fwd_pallas(q3, k3, v3, scale: float, causal: bool, block_q: int,
@@ -103,7 +114,7 @@ def _fwd_pallas(q3, k3, v3, scale: float, causal: bool, block_q: int,
     BH, T, D = q3.shape
     grid = (BH, T // block_q, T // block_k)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal)
-    return pl.pallas_call(
+    o, lse_lanes = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -117,22 +128,23 @@ def _fwd_pallas(q3, k3, v3, scale: float, causal: bool, block_q: int,
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i),
+            pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, T, D), q3.dtype),
-            jax.ShapeDtypeStruct((BH, T), jnp.float32),
+            jax.ShapeDtypeStruct((BH, T, _LANES), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block_q, 1), jnp.float32),   # running max
-            pltpu.VMEM((block_q, 1), jnp.float32),   # running sum
-            pltpu.VMEM((block_q, D), jnp.float32),   # accumulator
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running max
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running sum
+            pltpu.VMEM((block_q, D), jnp.float32),       # accumulator
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q3, k3, v3)
+    return o, lse_lanes[:, :, 0]
 
 
 def _fwd_xla(q3, k3, v3, scale: float, causal: bool):

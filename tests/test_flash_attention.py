@@ -97,6 +97,31 @@ class TestBackendSelection:
         with pytest.raises(ValueError, match="identical shape"):
             flash_attention_with_lse(q, k, k, force="xla")
 
+    @pytest.mark.parametrize("T,block", [(256, 128), (64, 128),
+                                         (192, 128)])
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_mosaic_lowering_accepts_blocks(self, T, block, causal):
+        """AOT-lower the REAL pallas path for platform 'tpu' from this
+        CPU process: jax runs Mosaic's block-mapping validation
+        (_check_block_mappings) at lowering time, no device needed.
+        Round 5 on-chip found that interpret mode accepts block shapes
+        Mosaic rejects (the [1, block_q] lse block); this pins the
+        whole failure class without a chip. Covers the clean 128-tile,
+        the one-block (block == T) path, and a gcd divisor (T=192 ->
+        block 64)."""
+        import fedtorch_tpu.ops.pallas.flash_attention as fa
+        q, k, v = _qkv(T=T, D=64)
+
+        def fwd(q, k, v):
+            (q3, k3, v3), _, scale, bq, bk, _ = fa._prep(
+                q, k, v, None, block, block, None)
+            o3 = fa._flash3(q3, k3, v3, scale, causal, bq, bk, True)
+            _, lse3 = fa._flash3_lse(q3, k3, v3, scale, causal, bq, bk,
+                                     True)
+            return o3, lse3
+
+        jax.jit(fwd).trace(q, k, v).lower(lowering_platforms=("tpu",))
+
     def test_degenerate_block_falls_back_to_xla(self, monkeypatch):
         """A prime-ish T collapses the divisor blocks to ~T; on TPU the
         [T, T] score tile would blow VMEM, so _prep must route the call
