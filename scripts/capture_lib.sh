@@ -15,6 +15,39 @@ wait_for_done() {
     done
 }
 
+# Shared stage-runner helpers (review r5: run/run_to were copied
+# verbatim across r4/r5 stage scripts; new stages call these).
+# Callers set FAILED=0 before the first call.
+run() {
+    echo "=== $* ==="
+    BENCH_PROBE_TRIES=2 "$@"
+    local rc=$?
+    echo "=== rc=$rc ==="
+    if [ $rc -ne 0 ]; then FAILED=1; fi
+    return $rc
+}
+
+run_to() {
+    local out="$1"; shift
+    echo "=== $* -> $out ==="
+    BENCH_PROBE_TRIES=2 "$@" > "$out.tmp" && mv "$out.tmp" "$out"
+    local rc=$?
+    rm -f "$out.tmp"
+    echo "=== rc=$rc ==="
+    if [ $rc -ne 0 ]; then FAILED=1; fi
+    return $rc
+}
+
+# One short-patience relay probe; returns 0 iff the relay answers.
+probe_relay() {
+    BENCH_PROBE_TRIES="${1:-3}" python - <<'EOF'
+import sys
+sys.path.insert(0, ".")
+from bench import probe_device
+sys.exit(0 if probe_device() else 1)
+EOF
+}
+
 # Lowering-A/B variant stage. The function names predate the round-5
 # default flip (they are called by name from tpu_capture_r5.sh /
 # _r5c.sh, which were running when the flip landed and cannot be

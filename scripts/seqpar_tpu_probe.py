@@ -1,0 +1,63 @@
+"""Diagnose the round-5 on-chip zoo failure: seqpar/ring err 0.078 vs
+the dense oracle on a 1-device TPU mesh (TPU_ZOO.json seqpar_1chip).
+
+Hypothesis: TPU f32 matmuls default to bf16-precision MXU passes
+(jax default_matmul_precision), so the sharded ring program and the
+dense oracle — different contraction orders — diverge at bf16 rounding
+scale. On CPU the same check passes at 1e-3 because CPU matmuls are
+true f32. This probe runs _run_sequence_parallel(1) under the default
+precision and under 'highest' (f32-accurate MXU passes): if 'highest'
+collapses the error by orders of magnitude, the divergence is MXU
+rounding, not a program bug.
+
+Writes SEQPAR_TPU_PROBE.json.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main():
+    import jax
+
+    from __graft_entry__ import _run_sequence_parallel
+
+    dev = jax.devices()[0]
+    results = {"platform": str(dev), "cases": {}}
+
+    for prec in ("default", "highest"):
+        try:
+            # tol=inf: we want the measured error, not the assert
+            worst = _run_sequence_parallel(
+                1, label=f"probe[{prec}]", tol=float("inf"),
+                matmul_precision=prec)
+            results["cases"][prec] = {"worst_err": worst}
+            print(f"precision={prec}: worst err {worst:.3e}")
+        except Exception as e:  # pragma: no cover - diagnostic
+            results["cases"][prec] = {"error": str(e)[:300]}
+            print(f"precision={prec}: FAIL {e}")
+
+    d = results["cases"].get("default", {}).get("worst_err")
+    h = results["cases"].get("highest", {}).get("worst_err")
+    if d is not None and h is not None:
+        results["ratio_default_over_highest"] = (
+            d / h if h > 0 else float("inf"))
+        results["finding"] = (
+            "MXU bf16-pass rounding artifact (highest-precision error "
+            "is orders of magnitude smaller)" if h < d / 30 else
+            "NOT explained by matmul precision alone — investigate "
+            "the ring program")
+        print(results["finding"])
+
+    with open(os.path.join(REPO, "SEQPAR_TPU_PROBE.json"), "w") as f:
+        json.dump(results, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -208,7 +208,25 @@ def main():
     results = {"platform": str(devs[0]), "cases": {}}
     ok = True
 
+    # ZOO_ONLY=substr[,substr...]: run only matching cases and MERGE
+    # them into the existing artifact (all_ok recomputed over the
+    # merged set). Lets a targeted fix re-validate one case in minutes
+    # of relay window instead of re-running the full zoo.
+    only = [s for s in os.environ.get("ZOO_ONLY", "").split(",") if s]
+    if only and not on_tpu:
+        # a PARTIAL CPU run must not clobber a real on-chip artifact
+        # with a one-case CPU record — refuse before running anything
+        log("ZOO_ONLY partial run off-TPU: artifact left untouched")
+        print(json.dumps({"tpu_zoo_ok": False, "skipped": True,
+                          "platform": results["platform"]}))
+        return 1
+
+    def selected(name: str) -> bool:
+        return not only or any(s in name for s in only)
+
     for name, fed_kw, trainer_kw in _zoo_configs(1):
+        if not selected(name):
+            continue
         t0 = time.time()
         try:
             m = _run_zoo_case(name, fed_kw, trainer_kw, 1)
@@ -226,6 +244,8 @@ def main():
             log(f"{name}: FAIL {str(e)[:200]}")
 
     for name, fn, kind in _model_cases():
+        if not selected(name):
+            continue
         t0 = time.time()
         try:
             val = fn()
@@ -249,6 +269,40 @@ def main():
         # nothing and must not produce a passing artifact
         ok = False
         log("NOT ON TPU — recording failure; rerun when the relay is up")
+
+    if only and not results["cases"]:
+        # a pattern that selects nothing must not write a vacuously
+        # green artifact
+        log(f"ZOO_ONLY={','.join(only)} matched no cases — not writing")
+        print(json.dumps({"tpu_zoo_ok": False, "skipped": True,
+                          "platform": results["platform"]}))
+        return 1
+
+    if only:
+        # partial run: merge into the prior ON-CHIP artifact; all_ok
+        # reflects the MERGED case set so one green re-run can't mask
+        # other failures (and vice versa). Refuse when there is no
+        # prior artifact or the prior is a CPU run — merging would
+        # stamp never-ran-on-chip cases into a green on-chip record.
+        prior = None
+        if os.path.exists("TPU_ZOO.json"):
+            with open("TPU_ZOO.json") as f:
+                prior = json.load(f)
+        if prior is None or "CPU RUN" in prior.get("note", ""):
+            log("ZOO_ONLY needs a prior on-chip TPU_ZOO.json to merge "
+                "into — run the full zoo first; not writing")
+            print(json.dumps({"tpu_zoo_ok": False, "skipped": True,
+                              "platform": results["platform"]}))
+            return 1
+        merged = dict(prior.get("cases", {}))
+        merged.update(results["cases"])
+        updated = sorted(results["cases"])
+        results["cases"] = merged
+        results["partial_update"] = {
+            "cases": updated,
+            "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+        ok = all(c.get("ok") for c in merged.values())
+
     results["all_ok"] = bool(ok)
     results["note"] = ("single-chip execution of every zoo case; the "
                        "sharded multi-device program is covered by "
