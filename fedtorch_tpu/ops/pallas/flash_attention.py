@@ -114,6 +114,15 @@ def _fwd_pallas(q3, k3, v3, scale: float, causal: bool, block_q: int,
     BH, T, D = q3.shape
     grid = (BH, T // block_q, T // block_k)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal)
+    # Under shard_map (ring/ulysses call this per shard), jax's vma
+    # check requires pallas_call outputs to declare which mesh axes
+    # they vary over — propagate the inputs' vma (round-5 on-chip
+    # finding: the CPU path never hit this because off-TPU flash falls
+    # back to the XLA oracle, so the real kernel inside shard_map was
+    # first exercised on the chip).
+    vma = frozenset().union(*(getattr(jax.typeof(t), "vma", frozenset())
+                              for t in (q3, k3, v3)))
+    vkw = {"vma": vma} if vma else {}
     o, lse_lanes = pl.pallas_call(
         kernel,
         grid=grid,
@@ -132,8 +141,8 @@ def _fwd_pallas(q3, k3, v3, scale: float, causal: bool, block_q: int,
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, T, D), q3.dtype),
-            jax.ShapeDtypeStruct((BH, T, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((BH, T, D), q3.dtype, **vkw),
+            jax.ShapeDtypeStruct((BH, T, _LANES), jnp.float32, **vkw),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),  # running max

@@ -15,24 +15,13 @@ wait_for_done() {
     done
 }
 
-# Shared stage-runner helpers (review r5: run/run_to were copied
-# verbatim across r4/r5 stage scripts; new stages call these).
+# Shared stage-runner helper (review r5: run was copied verbatim
+# across r4/r5 stage scripts; new stages call this one).
 # Callers set FAILED=0 before the first call.
 run() {
     echo "=== $* ==="
     BENCH_PROBE_TRIES=2 "$@"
     local rc=$?
-    echo "=== rc=$rc ==="
-    if [ $rc -ne 0 ]; then FAILED=1; fi
-    return $rc
-}
-
-run_to() {
-    local out="$1"; shift
-    echo "=== $* -> $out ==="
-    BENCH_PROBE_TRIES=2 "$@" > "$out.tmp" && mv "$out.tmp" "$out"
-    local rc=$?
-    rm -f "$out.tmp"
     echo "=== rc=$rc ==="
     if [ $rc -ne 0 ]; then FAILED=1; fi
     return $rc

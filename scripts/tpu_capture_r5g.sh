@@ -32,6 +32,10 @@ fi
 FAILED=0
 run python scripts/pallas_tpu_check.py     # -> PALLAS_TPU.json (precision-pinned flash correctness)
 run python scripts/flash_block_sweep.py    # -> FLASH_BLOCK_SWEEP.json
+# seqpar with the vma-propagating kernel (r5f's runs predate the fix:
+# ring+flash needs pallas_call out_shape vma under shard_map check_vma)
+run python scripts/seqpar_tpu_probe.py     # -> SEQPAR_TPU_PROBE.json
+run env ZOO_ONLY=seqpar python scripts/tpu_zoo_check.py
 
 # Quiet-host gate for the timed north-star run (up to 10 min of
 # patience; 1-min loadavg < 0.9 on this 1-core box).
@@ -43,6 +47,23 @@ for _ in $(seq 20); do
     sleep 30
 done
 run python bench.py                        # quiet re-persist -> TPU_BENCH_CAPTURE.json
+
+# bench.py exits 0 on a CPU fallback without touching the capture —
+# verify the re-persist actually happened (capture head == HEAD)
+CAP_HEAD="$(python - <<'EOF'
+import json
+try:
+    with open("TPU_BENCH_CAPTURE.json") as f:
+        print(json.load(f).get("git_head", ""))
+except Exception:
+    print("")
+EOF
+)"
+HEAD_NOW="$(git rev-parse HEAD)"
+if [ "$CAP_HEAD" != "$HEAD_NOW" ]; then
+    echo "[tpu_capture_r5g] re-persist did NOT refresh the capture (head $CAP_HEAD != $HEAD_NOW)"
+    FAILED=1
+fi
 
 ROUND5_START_UNIX=1785462780
 WEDGE_MIN_CAPTURED_UNIX="$ROUND5_START_UNIX" \

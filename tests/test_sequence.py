@@ -101,6 +101,27 @@ class TestRingFlashBlocks:
         with pytest.raises(ValueError, match="block_impl"):
             ring_attention(q, k, v, _mesh(2), block_impl="sparse")
 
+    @pytest.mark.parametrize("strategy", ["ring", "ulysses"])
+    def test_real_kernel_traces_under_shard_map_vma(self, strategy,
+                                                    monkeypatch):
+        """shard_map's check_vma requires pallas_call outputs to
+        declare their varying mesh axes; the kernel propagates the
+        inputs' vma onto out_shape. Off-TPU the flash call falls back
+        to the XLA oracle, so this combination first fired on the real
+        chip (round 5, SEQPAR_TPU_PROBE.json) — TRACING the real
+        pallas path here (no execution) pins the check on CPU."""
+        import fedtorch_tpu.ops.pallas.flash_attention as fa
+        from fedtorch_tpu.parallel.sequence import ulysses_attention
+
+        monkeypatch.setattr(fa, "on_tpu", lambda: True)
+        q, k, v = _qkv(s=64, seed=13)
+        mesh = _mesh(4)
+        fn = (ring_attention if strategy == "ring"
+              else ulysses_attention)
+        jax.jit(lambda q, k, v: fn(
+            q, k, v, mesh, causal=True,
+            block_impl="flash")).trace(q, k, v)
+
 
 class TestUlysses:
     """All-to-all (head-parallel) strategy: must agree with dense AND
