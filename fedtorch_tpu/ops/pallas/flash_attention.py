@@ -120,9 +120,14 @@ def _fwd_pallas(q3, k3, v3, scale: float, causal: bool, block_q: int,
     # finding: the CPU path never hit this because off-TPU flash falls
     # back to the XLA oracle, so the real kernel inside shard_map was
     # first exercised on the chip).
-    vma = frozenset().union(*(getattr(jax.typeof(t), "vma", frozenset())
-                              for t in (q3, k3, v3)))
-    vkw = {"vma": vma} if vma else {}
+    vmas = [getattr(jax.typeof(t), "vma", None) for t in (q3, k3, v3)]
+    if any(v is not None for v in vmas):
+        # pass vma even when EMPTY: inside shard_map with replicated
+        # q/k/v the check still requires an explicit (empty) vma
+        vkw = {"vma": frozenset().union(*(v or frozenset()
+                                          for v in vmas))}
+    else:  # very old jax: aval has no vma concept
+        vkw = {}
     o, lse_lanes = pl.pallas_call(
         kernel,
         grid=grid,
@@ -315,10 +320,23 @@ def _divisor_block(T: int, block: int) -> int:
     return d if d >= 16 else T
 
 
+def _default_blocks(T: int):
+    """Data-driven default block shape (FLASH_BLOCK_SWEEP.json, v5e,
+    fetch-synced timer): 128x128 loses 1.36-2.45x to the per-T winner;
+    (256, 512) wins at T<=2048 and (512, 512) at T>=4096 (1.48x vs
+    dense forward at T=8192). Both fit VMEM comfortably (<=1 MB score
+    tile; _MAX_BLOCK_ELEMS)."""
+    return (256, 512) if T <= 2048 else (512, 512)
+
+
 def _prep(q, k, v, scale, block_q, block_k, force):
     """Shared wrapper plumbing: [B,T,H,D] -> [BH,T,D] layout, divisor
     block sizes, backend selection."""
     B, T, H, D = q.shape
+    if block_q is None or block_k is None:
+        dq, dk = _default_blocks(T)
+        block_q = dq if block_q is None else block_q
+        block_k = dk if block_k is None else block_k
     if k.shape != q.shape or v.shape != q.shape:
         # The kernel grid and chunked VJP tile Q and K/V with one shared
         # T; unequal q/kv lengths (e.g. cross-attention or uneven K/V
@@ -356,17 +374,19 @@ def _prep(q, k, v, scale, block_q, block_k, force):
 
 
 def flash_attention(q, k, v, causal: bool = False,
-                    scale: Optional[float] = None, block_q: int = 128,
-                    block_k: int = 128,
+                    scale: Optional[float] = None,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     force: Optional[str] = None) -> jnp.ndarray:
     """Exact attention, [B, T, H, D] in/out, differentiable.
 
     Backend selection: the Pallas kernel on TPU; its interpreter when
     ``force='interpret'`` (CPU kernel tests); the dense-oracle math
     otherwise (CPU training/eval — same semantics, standard memory).
-    Requested block sizes are adjusted to divisors of T (static shapes:
-    decided once at trace time), so both the kernel grid and the
-    chunked VJP always tile the sequence exactly."""
+    Block sizes default to the measured per-T winners
+    (``_default_blocks``) and are adjusted to divisors of T (static
+    shapes: decided once at trace time), so both the kernel grid and
+    the chunked VJP always tile the sequence exactly."""
     (q3, k3, v3), (B, T, H, D), scale, bq, bk, use_pallas = _prep(
         q, k, v, scale, block_q, block_k, force)
     out3 = _flash3(q3, k3, v3, scale, causal, bq, bk, use_pallas)
@@ -375,7 +395,8 @@ def flash_attention(q, k, v, causal: bool = False,
 
 def flash_attention_with_lse(q, k, v, causal: bool = False,
                              scale: Optional[float] = None,
-                             block_q: int = 128, block_k: int = 128,
+                             block_q: Optional[int] = None,
+                             block_k: Optional[int] = None,
                              force: Optional[str] = None):
     """:func:`flash_attention` that also returns the logsumexp
     ([B, T, H] f32) — the merge statistic for combining attention over

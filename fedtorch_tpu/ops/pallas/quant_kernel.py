@@ -242,9 +242,12 @@ def fused_quantize_dequantize_tree(tree, num_bits: int = 8,
     per-leaf path costs one kernel launch per leaf while bucketing costs
     one per distinct size. Measured on the relay-attached v5e the
     end-to-end difference vs per-leaf XLA is within run-to-run noise
-    (+/-30%; PALLAS_TPU.json 'finding') — the transform is kept because
-    it is at-worst noise-equivalent, structurally bounds launch count,
-    and keeps per-tensor stats exact at every payload size.
+    (+/-30%; the round-5 fetch-synced samples read 0.83-0.90x,
+    PALLAS_TPU.json) — the transform is kept because it is at-worst
+    noise-equivalent, structurally bounds launch count, and keeps
+    per-tensor stats exact at every payload size; the clear pallas
+    wins are the large flat payloads (uplink 1.2x, 1M+ single tensors
+    1.2-1.8x fetch-synced).
 
     ``leading_batch=True`` marks uplink layout: each leaf carries a
     leading [k_online] axis and the bucket stacks to [b*k, n] so stats

@@ -1,0 +1,34 @@
+"""Fetch-synced device timing for the measurement scripts.
+
+`jax.block_until_ready` can no-op on the axon relay backend: round-5
+block-synced timers read 24-44us for computations whose MXU FLOPs
+floor is ~350us (FLASH_BLOCK_SWEEP.json first two captures;
+BASELINE_REPRO.md "timing-methodology finding"). Materializing result
+BYTES on the host provably waits for the in-order device stream, so
+every micro-benchmark syncs by fetching one element of its final
+output. Import from here — a copy-pasted variant that drifts back to
+block_until_ready silently resumes reading artifact timings.
+"""
+from __future__ import annotations
+
+import time
+
+
+def sync(out):
+    """Force real completion of `out` (any pytree) via a 1-element
+    device->host fetch of its first leaf; returns the fetched value."""
+    import jax
+    import numpy as np
+
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    return np.asarray(leaf[(0,) * getattr(leaf, "ndim", 0)])
+
+
+def timeit(fn, *args, iters: int = 20) -> float:
+    """Mean seconds per call over `iters` dispatches, fetch-synced."""
+    sync(fn(*args))  # warmup/compile, fully drained
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    sync(out)
+    return (time.perf_counter() - t0) / iters

@@ -65,13 +65,13 @@ def main():
         state = opt.init(params)
         t0 = time.time()
         params, state, loss = train_step(params, state)
-        jax.block_until_ready(loss)
-        compile_s = time.time() - t0
+        float(loss)  # fetch-sync: block_until_ready can no-op on the
+        compile_s = time.time() - t0  # relay (BASELINE_REPRO round 5)
         t0 = time.time()
         for _ in range(iters):
             params, state, loss = train_step(params, state)
-        jax.block_until_ready(loss)
-        return (time.time() - t0) / iters, compile_s, float(loss)
+        final_loss = float(loss)  # materialize BEFORE reading the clock
+        return (time.time() - t0) / iters, compile_s, final_loss
 
     for T in (1024, 2048, 4096, 8192):
         toks = jax.random.randint(jax.random.key(1), (B, T), 0, VOCAB)

@@ -18,7 +18,6 @@ from __future__ import annotations
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -52,12 +51,11 @@ _BIG_PAYLOAD = 1_000_000
 
 
 def _timeit(fn, *args, iters=50):
-    jax.block_until_ready(fn(*args))  # warmup/compile
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters
+    """Fetch-synced timing (scripts/bench_timing.py): block_until_ready
+    can no-op on the relay backend — round 5 block-synced timers read
+    24-44us for computations with a ~350us MXU FLOPs floor."""
+    from bench_timing import timeit
+    return timeit(fn, *args, iters=iters)
 
 
 def main():

@@ -46,22 +46,25 @@ for _ in $(seq 20); do
     echo "[tpu_capture_r5g] host busy (load $LOAD) — waiting"
     sleep 30
 done
+BENCH_T0="$(date +%s)"
 run python bench.py                        # quiet re-persist -> TPU_BENCH_CAPTURE.json
 
 # bench.py exits 0 on a CPU fallback without touching the capture —
-# verify the re-persist actually happened (capture head == HEAD)
-CAP_HEAD="$(python - <<'EOF'
-import json
+# verify the re-persist actually happened: the capture's timestamp
+# must postdate this stage's bench launch (a same-HEAD stale capture
+# from an earlier run would pass a head comparison)
+if ! CAP_AGE_OK="$(BENCH_T0="$BENCH_T0" python - <<'EOF'
+import json, os, sys
 try:
     with open("TPU_BENCH_CAPTURE.json") as f:
-        print(json.load(f).get("git_head", ""))
+        cap = json.load(f)
+    print(1 if cap.get("captured_unix", 0) >= int(os.environ["BENCH_T0"])
+          else 0)
 except Exception:
-    print("")
+    print(0)
 EOF
-)"
-HEAD_NOW="$(git rev-parse HEAD)"
-if [ "$CAP_HEAD" != "$HEAD_NOW" ]; then
-    echo "[tpu_capture_r5g] re-persist did NOT refresh the capture (head $CAP_HEAD != $HEAD_NOW)"
+)" || [ "$CAP_AGE_OK" != "1" ]; then
+    echo "[tpu_capture_r5g] re-persist did NOT refresh the capture (no capture newer than stage start)"
     FAILED=1
 fi
 

@@ -1,0 +1,27 @@
+#!/bin/bash
+# Round-5 stage 8: re-run the flash block sweep with the hardened
+# per-iteration-blocking timer. The first sweep capture returned
+# physically impossible per-iter times (below the MXU FLOPs floor —
+# see _timeit's docstring in scripts/flash_block_sweep.py); its
+# numbers were dispatch artifacts, not kernel times. The re-run also
+# cross-checks pallas_tpu_check's flash timings with the safer timer.
+#     nohup bash scripts/tpu_capture_r5h.sh > /tmp/tpu_capture_r5h.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.." || exit 1
+. scripts/capture_lib.sh
+R5G_DONE=/tmp/tpu_capture_r5g.done
+R5H_DONE=/tmp/tpu_capture_r5h.done
+rm -f "$R5H_DONE"
+trap 'touch "$R5H_DONE"' EXIT
+
+wait_for_done "$R5G_DONE"
+echo "[tpu_capture_r5h] r5g done — probing"
+if ! probe_relay 5; then
+    echo "[tpu_capture_r5h] relay dead; sweep not re-captured"
+    exit 1
+fi
+
+FAILED=0
+run python scripts/flash_block_sweep.py    # -> FLASH_BLOCK_SWEEP.json (trustworthy timer)
+echo "[tpu_capture_r5h] done (failed=$FAILED)"
+exit $FAILED

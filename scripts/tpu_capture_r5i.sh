@@ -1,0 +1,26 @@
+#!/bin/bash
+# Round-5 stage 9: third run of the flash block sweep, now with the
+# fetch-synced timer (jax.block_until_ready does not wait for device
+# execution on the axon relay backend — see _timeit's docstring in
+# scripts/flash_block_sweep.py; the first two sweep captures read
+# times below the MXU FLOPs floor and are flagged timing_untrusted).
+#     nohup bash scripts/tpu_capture_r5i.sh > /tmp/tpu_capture_r5i.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.." || exit 1
+. scripts/capture_lib.sh
+R5H_DONE=/tmp/tpu_capture_r5h.done
+R5I_DONE=/tmp/tpu_capture_r5i.done
+rm -f "$R5I_DONE"
+trap 'touch "$R5I_DONE"' EXIT
+
+wait_for_done "$R5H_DONE"
+echo "[tpu_capture_r5i] r5h done — probing"
+if ! probe_relay 5; then
+    echo "[tpu_capture_r5i] relay dead; sweep not re-captured"
+    exit 1
+fi
+
+FAILED=0
+run python scripts/flash_block_sweep.py    # -> FLASH_BLOCK_SWEEP.json (fetch-synced timer)
+echo "[tpu_capture_r5i] done (failed=$FAILED)"
+exit $FAILED

@@ -1,0 +1,29 @@
+#!/bin/bash
+# Round-5 stage 10 (final capture stage): re-measure every micro-timing
+# artifact with the fetch-synced timer (jax.block_until_ready can no-op
+# on the relay backend — flash_block_sweep._timeit docstring), and the
+# flash training A/B with the kernel's new data-driven block defaults.
+# bench.py and the accuracy curves were never affected (single-dispatch
+# segments whose duration self-evidences real execution / per-segment
+# metric fetches).
+#     nohup bash scripts/tpu_capture_r5j.sh > /tmp/tpu_capture_r5j.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.." || exit 1
+. scripts/capture_lib.sh
+R5I_DONE=/tmp/tpu_capture_r5i.done
+R5J_DONE=/tmp/tpu_capture_r5j.done
+rm -f "$R5J_DONE"
+trap 'touch "$R5J_DONE"' EXIT
+
+wait_for_done "$R5I_DONE"
+echo "[tpu_capture_r5j] r5i done — probing"
+if ! probe_relay 5; then
+    echo "[tpu_capture_r5j] relay dead; re-measurement not captured"
+    exit 1
+fi
+
+FAILED=0
+run python scripts/pallas_tpu_check.py      # -> PALLAS_TPU.json (fetch-synced quantize + flash timings)
+run python scripts/flash_train_bench.py     # -> FLASH_TRAIN.json (new block defaults, fetch-synced)
+echo "[tpu_capture_r5j] done (failed=$FAILED)"
+exit $FAILED
