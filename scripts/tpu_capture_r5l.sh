@@ -1,0 +1,26 @@
+#!/bin/bash
+# Round-5 stage 12 (opportunistic): if the tail watchdog (r5k)
+# converted a relay recovery, spend any remaining window on the one
+# ambiguous flash data point — the T=2048 training-step A/B read
+# 1.04x (old 128x128 blocks), 0.68x (new (256,512) blocks), and the
+# forward-only sweep 1.08x across three same-day samples (+/-30% relay
+# variance), so a fourth sample decides whether the (256,512) default
+# holds there. Runs the full flash_train_bench (fetch-synced).
+#     nohup bash scripts/tpu_capture_r5l.sh > /tmp/tpu_capture_r5l.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.." || exit 1
+. scripts/capture_lib.sh
+R5K_DONE=/tmp/tpu_capture_r5k.done
+rm -f /tmp/tpu_capture_r5l.done
+trap 'touch /tmp/tpu_capture_r5l.done' EXIT
+
+wait_for_done "$R5K_DONE"
+echo "[tpu_capture_r5l] watchdog done — probing"
+if ! probe_relay 2; then
+    echo "[tpu_capture_r5l] relay dead; no extra sample"
+    exit 1
+fi
+FAILED=0
+run python scripts/flash_train_bench.py    # -> FLASH_TRAIN.json (4th T=2048 sample)
+echo "[tpu_capture_r5l] done (failed=$FAILED)"
+exit $FAILED
