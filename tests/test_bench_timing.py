@@ -1,0 +1,58 @@
+"""Pin the fetch-synced timer (scripts/bench_timing.py) — the relay
+workaround every micro-benchmark depends on (BASELINE_REPRO.md
+"timing-methodology finding"): sync() must materialize real bytes for
+any result shape, and timeit() must return a sane per-call mean."""
+import importlib.util
+import os
+
+import jax
+import jax.numpy as jnp
+
+# load the script module without mutating sys.path (same pattern as
+# test_bench_capture.py): a path insert would shadow any test-session
+# import that collides with a scripts/ filename
+_spec = importlib.util.spec_from_file_location(
+    "bench_timing", os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts", "bench_timing.py"))
+_mod = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_mod)
+sync, timeit = _mod.sync, _mod.timeit
+
+
+class TestSync:
+    def test_array(self):
+        out = jnp.arange(12.0).reshape(3, 4)
+        assert float(sync(out)) == 0.0
+
+    def test_scalar(self):
+        # ndim-0 leaf: the (0,)*0 == () index path
+        assert float(sync(jnp.float32(7.0))) == 7.0
+
+    def test_pytree(self):
+        tree = {"a": (jnp.ones((2, 2)), jnp.zeros(3))}
+        assert float(sync(tree)) == 1.0  # first leaf
+
+    def test_grad_tuple(self):
+        # the block-sweep fwd+bwd shape: a tuple of grads
+        g = jax.grad(lambda q, k: jnp.sum(q ** 2 + k), argnums=(0, 1))(
+            jnp.ones(4), jnp.ones(4))
+        assert float(sync(g)) == 2.0
+
+
+class TestTimeit:
+    def test_returns_positive_mean(self):
+        f = jax.jit(lambda x: x @ x)
+        x = jnp.ones((64, 64))
+        t = timeit(f, x, iters=3)
+        assert t > 0
+
+    def test_actually_calls_iters_times(self):
+        calls = []
+
+        def f(x):
+            calls.append(1)
+            return x + 1
+
+        timeit(f, jnp.ones(4), iters=5)
+        assert len(calls) == 6  # warmup + iters

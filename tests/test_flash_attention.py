@@ -122,6 +122,23 @@ class TestBackendSelection:
 
         jax.jit(fwd).trace(q, k, v).lower(lowering_platforms=("tpu",))
 
+    def test_default_blocks_follow_measured_winners(self):
+        """Block defaults come from the on-chip sweep
+        (FLASH_BLOCK_SWEEP.json): (256, 512) at T<=2048, (512, 512)
+        above; explicit args override; divisor adjustment still
+        applies (T=256 -> one 256-block)."""
+        import fedtorch_tpu.ops.pallas.flash_attention as fa
+
+        assert fa._default_blocks(1024) == (256, 512)
+        assert fa._default_blocks(2048) == (256, 512)
+        assert fa._default_blocks(4096) == (512, 512)
+
+        q, k, v = _qkv(T=256, D=16)
+        *_, bq, bk, _ = fa._prep(q, k, v, None, None, None, None)
+        assert (bq, bk) == (256, 256)  # defaults clamped to divisors
+        *_, bq, bk, _ = fa._prep(q, k, v, None, 64, 64, None)
+        assert (bq, bk) == (64, 64)    # explicit args respected
+
     def test_degenerate_block_falls_back_to_xla(self, monkeypatch):
         """A prime-ish T collapses the divisor blocks to ~T; on TPU the
         [T, T] score tile would blow VMEM, so _prep must route the call
