@@ -22,5 +22,9 @@ if ! probe_relay 2; then
 fi
 FAILED=0
 run python scripts/flash_train_bench.py    # -> FLASH_TRAIN.json (4th T=2048 sample)
+# on-chip head-to-head at the closing head (round 2 measured 4-16x
+# with per-round dispatch; the CPU re-run at the batched-scan engine
+# reads 47-266x — this records the on-chip side of that update)
+run python scripts/compare_reference.py --rounds 10 --tpu
 echo "[tpu_capture_r5l] done (failed=$FAILED)"
 exit $FAILED
