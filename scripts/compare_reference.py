@@ -69,6 +69,18 @@ def install_reference_shims():
 
     M.accuracy = _accuracy
 
+    # The reference's centered main CALLS qffl_aggregation_centered
+    # (centered/main.py:206) but never imports it (main.py:18-22 pulls
+    # only fedavg/fedgate/scaffold/qsparse) — its own qFFL entry path
+    # crashes with NameError. Inject the function it meant to import
+    # (defined at comms/algorithms/federated/centered/qffl.py:4) so
+    # the comparison can still run the reference as intended.
+    import fedtorch.comms.trainings.federated.centered.main as ref_main_mod
+    if not hasattr(ref_main_mod, "qffl_aggregation_centered"):
+        from fedtorch.comms.algorithms.federated.centered.qffl import \
+            qffl_aggregation_centered
+        ref_main_mod.qffl_aggregation_centered = qffl_aggregation_centered
+
 
 def reference_argv(algo: str, rounds: int, extra=()):
     argv = [
@@ -171,7 +183,14 @@ def run_ours(algo: str, rounds: int, cx, cy, tx, ty,
     model = define_model(cfg, batch_size=20)
     trainer = FederatedTrainer(cfg, model, make_algorithm(cfg), data)
     server, clients = trainer.init_state(jax.random.key(6))
-    trainer.run_round(server, clients)  # compile warmup
+    # compile warmup — TWO rounds, because algorithms with round-0
+    # forcing (afl: uniform round 0, lambda-weighted afterwards) jit
+    # two distinct round programs; a 1-round warmup left the second
+    # compile inside the timed loop (measured: afl rounds 0 AND 1
+    # each ~2.3s, rounds 2+ ~1ms)
+    s, c, _ = trainer.run_round(server, clients)
+    s, c, _ = trainer.run_round(s, c)
+    jax.block_until_ready(s.params)  # drain warmup before the timer
     server, clients = trainer.init_state(jax.random.key(6))
     t0 = time.time()
     for _ in range(rounds):
