@@ -218,9 +218,14 @@ def main():
         with open(f"{WORKDIR}/ref_{algo}.log") as f:
             for line in f:
                 m = re.search(
-                    r"(Global performance for train|Test) at batch.*"
-                    r"Prec@1: ([\d.]+).*Loss: ([\d.]+)", line)
+                    r"(Global performance for train"
+                    r"|Global performance for validation|Test)"
+                    r" at batch.*Prec@1: ([\d.]+).*Loss: ([\d.]+)",
+                    line)
                 if m:
+                    # personal-eval paths (apfl) log the held-out
+                    # metric as "Global performance for validation"
+                    # instead of a "Test" line
                     key = "train" if "train" in m.group(1) else "test"
                     last[key] = float(m.group(2))
         return last
