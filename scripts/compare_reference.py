@@ -102,6 +102,8 @@ def reference_argv(algo: str, rounds: int, extra=()):
         argv += ["--federated_drfa", "True", "--drfa_gamma", "0.1"]
     if algo == "apfl":
         argv += ["--fed_personal", "True", "--fed_personal_alpha", "0.5"]
+    if algo in ("perfedavg", "perfedme"):
+        argv += ["--fed_personal", "True"]
     return argv + list(extra)
 
 
@@ -166,6 +168,14 @@ def run_ours(algo: str, rounds: int, cx, cy, tx, ty,
     feats, labels = np.concatenate(cx), np.concatenate(cy)
     offs = np.concatenate([[0], np.cumsum(sizes)])
     parts = [np.arange(offs[i], offs[i + 1]) for i in range(len(sizes))]
+    val_data = None
+    if algo in ("perfedavg", "perfedme"):
+        # MAML-style algorithms evaluate on per-client validation
+        # batches (needs_val_batch); same 10% split convention as
+        # build_federated_data / the reference's random_split
+        from fedtorch_tpu.data.batching import train_val_split
+        parts, val_parts = train_val_split(parts, 0.1, seed=6)
+        val_data = stack_partitions(feats, labels, val_parts)
     data = stack_partitions(feats, labels, parts)
 
     cfg = ExperimentConfig(
@@ -181,7 +191,8 @@ def run_ours(algo: str, rounds: int, cx, cy, tx, ty,
         train=TrainConfig(local_step=5),
     ).finalize()
     model = define_model(cfg, batch_size=20)
-    trainer = FederatedTrainer(cfg, model, make_algorithm(cfg), data)
+    trainer = FederatedTrainer(cfg, model, make_algorithm(cfg), data,
+                               val_data=val_data)
     server, clients = trainer.init_state(jax.random.key(6))
     # compile warmup — TWO rounds, because algorithms with round-0
     # forcing (afl: uniform round 0, lambda-weighted afterwards) jit
