@@ -20,6 +20,7 @@ See SURVEY.md for the blueprint and file:line parity citations.
 __version__ = "0.1.0"
 
 from fedtorch_tpu.config import (  # noqa: F401
-    CheckpointConfig, DataConfig, ExperimentConfig, FederatedConfig,
-    LRConfig, MeshConfig, ModelConfig, OptimConfig, TrainConfig,
+    CheckpointConfig, DataConfig, ExperimentConfig, FaultConfig,
+    FederatedConfig, LRConfig, MeshConfig, ModelConfig, OptimConfig,
+    TrainConfig,
 )

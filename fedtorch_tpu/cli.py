@@ -20,8 +20,9 @@ import time
 from typing import Optional
 
 from fedtorch_tpu.config import (
-    CheckpointConfig, DataConfig, ExperimentConfig, FederatedConfig,
-    LRConfig, MeshConfig, ModelConfig, OptimConfig, TrainConfig,
+    CheckpointConfig, DataConfig, ExperimentConfig, FaultConfig,
+    FederatedConfig, LRConfig, MeshConfig, ModelConfig, OptimConfig,
+    TrainConfig,
 )
 
 
@@ -182,6 +183,39 @@ def build_parser() -> argparse.ArgumentParser:
                    default=False)
     p.add_argument("--log_dir", default="./logdir/")
     p.add_argument("--experiment", default=None)
+    # robustness: chaos injection / update guards / round supervisor
+    # (docs/robustness.md; no reference analog — it is fail-stop)
+    p.add_argument("--fault_client_drop_rate", type=float, default=0.0,
+                   help="per-round probability an online client crashes "
+                        "mid-round (masked out of aggregation, weights "
+                        "renormalized over survivors)")
+    p.add_argument("--fault_straggler_rate", type=float, default=0.0,
+                   help="per-round probability an online client is a "
+                        "straggler (completes only a fraction of its "
+                        "local steps)")
+    p.add_argument("--fault_straggler_step_frac", type=float, default=0.5,
+                   help="fraction of the step budget a straggler "
+                        "completes before missing the deadline")
+    p.add_argument("--fault_nan_inject_rate", type=float, default=0.0,
+                   help="per-round probability an online client uploads "
+                        "a NaN-poisoned delta (exercises the guards)")
+    p.add_argument("--guard_updates", type=str2bool, default=False,
+                   help="screen client deltas before aggregation: "
+                        "reject non-finite, reject/clip norm-exploded")
+    p.add_argument("--guard_norm_multiplier", type=float, default=10.0,
+                   help="norm threshold as a multiple of the round's "
+                        "median surviving delta norm")
+    p.add_argument("--guard_mode", default="reject",
+                   choices=("reject", "clip"))
+    p.add_argument("--supervisor", type=str2bool, default=False,
+                   help="wrap the round loop with divergence detection, "
+                        "snapshot rollback, retry with backoff, and "
+                        "round skipping (docs/robustness.md)")
+    p.add_argument("--supervisor_loss_blowup", type=float, default=0.0,
+                   help=">0: mean online loss above this multiple of "
+                        "the running loss EMA counts as divergence")
+    p.add_argument("--supervisor_max_retries", type=int, default=2)
+    p.add_argument("--supervisor_backoff_base", type=float, default=0.5)
     # device / mesh (replaces parameters.py:225-236 MPI block)
     p.add_argument("--backend", default=None,
                    help="jax platform: tpu|cpu|None(auto)")
@@ -307,6 +341,18 @@ def args_to_config(args) -> ExperimentConfig:
             num_processes=args.num_processes, process_id=args.process_id,
             compute_dtype=args.compute_dtype,
             scan_unroll=args.scan_unroll, remat=args.remat),
+        fault=FaultConfig(
+            client_drop_rate=args.fault_client_drop_rate,
+            straggler_rate=args.fault_straggler_rate,
+            straggler_step_frac=args.fault_straggler_step_frac,
+            nan_inject_rate=args.fault_nan_inject_rate,
+            guard_updates=args.guard_updates,
+            guard_norm_multiplier=args.guard_norm_multiplier,
+            guard_mode=args.guard_mode,
+            supervisor=args.supervisor,
+            loss_blowup_factor=args.supervisor_loss_blowup,
+            max_retries=args.supervisor_max_retries,
+            backoff_base_s=args.supervisor_backoff_base),
         experiment=args.experiment,
     )
     return cfg.finalize()
@@ -386,6 +432,13 @@ def run_experiment(cfg: ExperimentConfig,
     if cfg.checkpoint.async_save:
         from fedtorch_tpu.utils import AsyncCheckpointer
         async_ckpt = AsyncCheckpointer()
+    supervisor = None
+    run_round = trainer.run_round
+    if cfg.fault.supervisor:
+        from fedtorch_tpu.robustness import RoundSupervisor
+        supervisor = RoundSupervisor(trainer, checkpoint_dir=ckpt_dir,
+                                     logger=logger)
+        run_round = supervisor.run_round
     results = {}
     start_round = int(server.round)
     loop_raised = False
@@ -396,10 +449,20 @@ def run_experiment(cfg: ExperimentConfig,
             prev_params = jax.tree.map(jnp.copy, server.params) \
                 if cfg.checkpoint.track_model_aggregation else None
             timer.start("round")
-            server, clients, metrics = trainer.run_round(server, clients)
+            server, clients, metrics = run_round(server, clients)
             jax.block_until_ready(server.params)
             round_time = timer.stop("round")
             timer.add_comm(num_bytes=float(metrics.comm_bytes))
+
+            if cfg.fault.chaos_enabled or cfg.fault.guard_updates:
+                dropped = float(metrics.dropped_clients)
+                rej = float(metrics.rejected_updates)
+                clip = float(metrics.clipped_updates)
+                strag = float(metrics.straggler_clients)
+                if dropped or rej or clip or strag:
+                    logger.log(f"Round {r}: faults — dropped={dropped:.0f}"
+                               f" stragglers={strag:.0f} rejected={rej:.0f}"
+                               f" clipped={clip:.0f}")
 
             if cfg.checkpoint.check_model_at_sync:
                 norms = model_norms(server.params)
@@ -479,6 +542,18 @@ def run_experiment(cfg: ExperimentConfig,
             finally:
                 timer.stop("checkpoint")
     results["best_top1"] = best_prec1
+    if supervisor is not None:
+        st = supervisor.stats
+        results["supervisor"] = {
+            "rounds": st.rounds, "retries": st.retries,
+            "rollbacks": st.rollbacks,
+            "skipped_rounds": st.skipped_rounds,
+            "disk_restores": st.disk_restores,
+            "last_good_round": st.last_good_round}
+        if st.rollbacks:
+            logger.log(f"supervisor: {st.rollbacks} rollback(s), "
+                       f"{st.retries} retrie(s), {st.skipped_rounds} "
+                       "skipped round(s)")
     results["timer"] = timer.summary()
     logger.log(f"phase timers: {timer.summary()}")
     return results

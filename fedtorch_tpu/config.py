@@ -243,6 +243,68 @@ class CheckpointConfig:
 
 
 @dataclass(frozen=True)
+class FaultConfig:
+    """Fault injection, update guards, and round-supervisor knobs.
+
+    The reference has NO fault handling: its MPI mode is fail-stop (one
+    dead client kills the ``mpirun`` job) and a NaN client update
+    poisons the server silently. These knobs drive the robustness
+    subsystem (``fedtorch_tpu.robustness``, docs/robustness.md):
+
+    * Chaos injection runs INSIDE the jitted round program and is
+      deterministic under the threaded PRNG — a seeded run replays the
+      exact same crash/straggler/poison schedule.
+    * Update guards screen client deltas server-side before aggregation.
+    * The supervisor wraps the host round loop with rollback + retry.
+    """
+    # -- chaos injection (parallel/federated.py) -----------------------
+    # per-round probability each ONLINE client crashes mid-round: its
+    # update is masked out of aggregation, surviving weights are
+    # renormalized, and its local state rolls back (fail-stop semantics)
+    client_drop_rate: float = 0.0
+    # per-round probability an online client is a straggler: it only
+    # completes ceil(straggler_step_frac * budget) of its local steps
+    # (reuses the epoch-sync freeze mask, so frozen steps cost lockstep
+    # FLOPs but change nothing)
+    straggler_rate: float = 0.0
+    straggler_step_frac: float = 0.5
+    # per-round probability an online client uploads a non-finite
+    # (NaN-poisoned) delta — exercises the update guards end to end
+    nan_inject_rate: float = 0.0
+    # fold constant separating the chaos stream from the round's
+    # sampling/training streams (fixed; exposed for reproducibility
+    # experiments that want distinct chaos schedules on one data seed)
+    chaos_salt: int = 0x7FFFFFFD
+    # -- server-side update guards -------------------------------------
+    # screen client deltas before aggregation: non-finite deltas are
+    # always rejected; finite deltas whose global l2 norm exceeds
+    # guard_norm_multiplier x the median surviving norm are rejected
+    # (guard_mode='reject') or scaled down to the threshold
+    # (guard_mode='clip'). Rejected weight is renormalized over the
+    # accepted clients.
+    guard_updates: bool = False
+    guard_norm_multiplier: float = 10.0
+    guard_mode: str = "reject"  # 'reject' | 'clip'
+    # -- host-side round supervisor ------------------------------------
+    supervisor: bool = False
+    # non-finite server params always trigger rollback; >0 additionally
+    # treats mean online loss > factor x the running loss EMA as
+    # divergence
+    loss_blowup_factor: float = 0.0
+    max_retries: int = 2
+    backoff_base_s: float = 0.5
+    # fold the attempt number into the server PRNG on retry so the
+    # retried round draws a fresh participation/chaos schedule (an
+    # unchanged deterministic program would reproduce the failure)
+    reseed_on_retry: bool = True
+
+    @property
+    def chaos_enabled(self) -> bool:
+        return (self.client_drop_rate > 0.0 or self.straggler_rate > 0.0
+                or self.nan_inject_rate > 0.0)
+
+
+@dataclass(frozen=True)
 class MeshConfig:
     """Device mesh layout — replaces the reference's process topology
     (``FCGraph``, utils/topology.py:57-114) with a JAX mesh.
@@ -259,6 +321,11 @@ class MeshConfig:
     coordinator_address: Optional[str] = None
     num_processes: Optional[int] = None
     process_id: Optional[int] = None
+    # Multi-host bring-up resilience (init_multihost retries transient
+    # connect failures): total budget for reaching the coordinator, and
+    # the first retry delay (doubles per attempt).
+    init_timeout_s: float = 300.0
+    init_backoff_s: float = 1.0
     compute_dtype: str = "float32"  # 'bfloat16' for MXU-friendly matmuls
     # Unroll factor for the local-step scan: >1 lets XLA software-
     # pipeline consecutive local steps (more instruction-level overlap,
@@ -283,6 +350,7 @@ class ExperimentConfig:
     train: TrainConfig = field(default_factory=TrainConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
+    fault: FaultConfig = field(default_factory=FaultConfig)
     experiment: Optional[str] = None
 
     def finalize(self) -> "ExperimentConfig":
@@ -348,6 +416,27 @@ class ExperimentConfig:
             raise ValueError(
                 f"model.conv_impl must be 'auto', 'conv' or 'matmul', "
                 f"got {self.model.conv_impl!r}")
+        flt = self.fault
+        for name in ("client_drop_rate", "straggler_rate",
+                     "nan_inject_rate"):
+            v = getattr(flt, name)
+            if not 0.0 <= v <= 1.0:
+                raise ValueError(f"fault.{name} must be in [0, 1], got {v}")
+        if not 0.0 < flt.straggler_step_frac <= 1.0:
+            raise ValueError(
+                "fault.straggler_step_frac must be in (0, 1], got "
+                f"{flt.straggler_step_frac}")
+        if flt.guard_mode not in ("reject", "clip"):
+            raise ValueError(
+                f"fault.guard_mode must be 'reject' or 'clip', got "
+                f"{flt.guard_mode!r}")
+        if flt.guard_norm_multiplier <= 0.0:
+            raise ValueError(
+                "fault.guard_norm_multiplier must be > 0, got "
+                f"{flt.guard_norm_multiplier}")
+        if flt.max_retries < 0:
+            raise ValueError(
+                f"fault.max_retries must be >= 0, got {flt.max_retries}")
 
         return dataclasses.replace(
             self, data=data, federated=fed, train=train, optim=optim)

@@ -39,11 +39,19 @@ class ServerState(NamedTuple):
 
 
 class RoundMetrics(NamedTuple):
-    """What the reference logs per round (logs/logging.py:83-117)."""
+    """What the reference logs per round (logs/logging.py:83-117), plus
+    the robustness counters (docs/robustness.md): a client that crashed
+    mid-round is removed from ``online_mask`` (it contributed nothing),
+    and the fault scalars record what the chaos layer and the update
+    guards did this round. All are 0 when faults/guards are off."""
     train_loss: jnp.ndarray   # [C] mean local loss (masked)
     train_acc: jnp.ndarray    # [C] mean local top-1 (masked)
     online_mask: jnp.ndarray  # [C]
     comm_bytes: jnp.ndarray   # scalar — payload volume this round
+    dropped_clients: jnp.ndarray = 0.0    # scalar — chaos crashes
+    straggler_clients: jnp.ndarray = 0.0  # scalar — step-budget cuts
+    rejected_updates: jnp.ndarray = 0.0   # scalar — guard rejections
+    clipped_updates: jnp.ndarray = 0.0    # scalar — guard norm clips
 
 
 def tree_where(pred, on_true, on_false):

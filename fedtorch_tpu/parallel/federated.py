@@ -29,6 +29,13 @@ Design (SURVEY.md §7):
   delta-as-grad with rank weights) and tree-summed over the client axis —
   a ``psum``-shaped reduction XLA lowers onto ICI. Every device applies
   the same server step (replicated-server semantics, fedavg.py:89-97).
+* Fault tolerance (docs/robustness.md): ``cfg.fault`` drives a
+  deterministic in-program chaos layer (client crashes masked out of
+  aggregation with weight renormalization, straggler step cuts on the
+  epoch-sync freeze mask, NaN-poisoned uploads) and server-side update
+  guards (non-finite / norm-exploded deltas rejected or clipped before
+  the sum). All gating is static config — faults off traces the exact
+  fault-free program.
 """
 from __future__ import annotations
 
@@ -46,6 +53,7 @@ from fedtorch_tpu.core.losses import make_criterion, per_sample_loss
 from fedtorch_tpu.core.schedule import LRSchedule, compile_schedule, lr_at
 from fedtorch_tpu.core.state import (
     ClientState, RoundMetrics, ServerState, tree_bytes, tree_sub,
+    tree_where, tree_zeros_like,
 )
 from fedtorch_tpu.data.batching import ClientData, epoch_permutation, \
     pad_client_axis, take_batch
@@ -53,6 +61,9 @@ from fedtorch_tpu.models.common import ModelDef
 from fedtorch_tpu.ops.augment import augment_image_batch
 from fedtorch_tpu.parallel.mesh import make_mesh, padded_client_count, \
     replicate, shard_clients
+from fedtorch_tpu.robustness.chaos import draw_chaos_plan, no_chaos_plan, \
+    poison_tree
+from fedtorch_tpu.robustness.guards import screen_payloads
 
 
 def participation_indices(rng: jax.Array, num_clients: int, k: int,
@@ -100,6 +111,15 @@ class FederatedTrainer:
         else:
             self.local_steps = max(cfg.train.local_step, 1)
         self.epoch_sync = cfg.federated.sync_type == "epoch"
+
+        # fault layer (docs/robustness.md): all gating is STATIC config,
+        # so with faults off the traced round program is unchanged.
+        # Straggler cuts reuse the epoch-sync freeze mask, which must
+        # then also run in local_step mode.
+        self.fault = cfg.fault
+        self.chaos_on = cfg.fault.chaos_enabled
+        self.guard_on = cfg.fault.guard_updates
+        self.mask_steps = self.epoch_sync or cfg.fault.straggler_rate > 0.0
 
         # 'batch' gathers only the K*B rows each online client will touch
         # this round (bounds cross-device movement when K*B < shard
@@ -193,6 +213,15 @@ class FederatedTrainer:
         weights = alg.client_weights(server.aux, idx, num_online_eff,
                                      jnp.take(data.sizes, idx))
 
+        # deterministic chaos schedule for this round (crash/straggler/
+        # poison masks over the online clients) — its own fold of the
+        # round key, so fault-free streams are untouched
+        flt = self.fault
+        plan = draw_chaos_plan(
+            jax.random.fold_in(rng_round, flt.chaos_salt),
+            self.k_online, flt) if self.chaos_on \
+            else no_chaos_plan(self.k_online)
+
         # gather online-client state & data rows (the per-round new_group)
         take = lambda t: jax.tree.map(lambda x: jnp.take(x, idx, axis=0), t)
         on_clients = take(clients)
@@ -256,10 +285,14 @@ class FederatedTrainer:
         on_aux0 = alg.pre_round(on_clients.aux, server=server, x=pre_x,
                                 y=pre_y, sizes=on_sizes, lr=on_lrs,
                                 rng=rng_round)
+        # round-start state, kept for crashed clients: fail-stop means
+        # everything after round start (incl. the pre_round aux write)
+        # is lost on the client
+        on_clients0 = on_clients
         on_clients = on_clients._replace(aux=on_aux0)
 
         def client_round(cstate: ClientState, x, y, vx, vy, size, vsize,
-                         weight, rng_c):
+                         weight, rng_c, bscale):
             # batch mode: x/y are the round's pre-selected rows [K*B, ...]
             # shard mode: x/y are whole shards [n_max, ...], rows picked
             # per step (nothing larger than the shard is materialized)
@@ -313,10 +346,17 @@ class FederatedTrainer:
             step_budget = (nb.astype(jnp.int32)
                            * self.cfg.federated.num_epochs_per_comm) \
                 if self.epoch_sync else jnp.asarray(K, jnp.int32)
+            if flt.straggler_rate > 0.0:
+                # straggler chaos: the client misses the round deadline
+                # after a fraction of ITS OWN budget (>= 1 step); rides
+                # the same freeze mask as epoch-sync early exit
+                step_budget = jnp.maximum(jnp.ceil(
+                    step_budget.astype(jnp.float32) * bscale), 1.0) \
+                    .astype(jnp.int32)
 
             def step(carry, k):
                 params, opt, aux, epoch, li, rnn_carry = carry
-                active = (k < step_budget) if self.epoch_sync \
+                active = (k < step_budget) if self.mask_steps \
                     else jnp.asarray(True)
                 lr = lr_at(self.schedule, epoch)
                 if batch_mode:
@@ -347,7 +387,7 @@ class FederatedTrainer:
                     server_aux=server.aux, bx=bx, by=by, bval_x=bval_x,
                     bval_y=bval_y, lr=lr, rng=drop_rng, step_idx=k,
                     local_index=li, step_budget=step_budget)
-                if self.epoch_sync:
+                if self.mask_steps:
                     sel = lambda n, o: jax.tree.map(
                         lambda a, b: jnp.where(active, a, b), n, o)
                     n_params, n_opt = sel(n_params, params), sel(n_opt, opt)
@@ -380,17 +420,57 @@ class FederatedTrainer:
 
         payloads, deltas, new_on_clients, (losses, accs) = jax.vmap(
             client_round)(on_clients, on_x, on_y, on_vx, on_vy, on_sizes,
-                          on_vsizes, weights, rngs)
+                          on_vsizes, weights, rngs, plan.budget_scale)
+
+        # poison chaos: the client's UPLOAD goes non-finite (its local
+        # state stays sane — the fault is at the wire, so ``deltas``
+        # itself must stay clean: client_post consumes it for persistent
+        # aux updates like FedGATE's tracking variate). ``wire_deltas``
+        # is what the guards judge — the poisoned view the server saw.
+        wire_deltas = deltas
+        if flt.nan_inject_rate > 0.0:
+            wire_deltas = poison_tree(deltas, plan.nan_inject)
 
         # uplink wire format on the stacked [k] payload axis (per-client
         # quantization via the pallas client-grid kernel — outside the
         # vmap, where pallas_call can actually run)
         payloads = alg.payload_batch_transform(payloads)
+        if flt.nan_inject_rate > 0.0:
+            payloads = poison_tree(payloads, plan.nan_inject)
+
+        # server-side screening: crashed clients never arrive; with
+        # guards on, non-finite / norm-exploded deltas are rejected or
+        # clipped (guards.py). ``accept`` is the final aggregation mask
+        # and the surviving aggregation weight is renormalized so the
+        # server step keeps its fault-free magnitude.
+        rejected = clipped = jnp.zeros(())
+        if self.guard_on:
+            payloads, report = screen_payloads(wire_deltas, payloads,
+                                               plan.survive, flt)
+            accept, rejected, clipped = (report.accept, report.rejected,
+                                         report.clipped)
+        elif self.chaos_on:
+            accept = plan.survive
+            payloads = tree_where(accept, payloads,
+                                  tree_zeros_like(payloads))
+        else:
+            accept = None
+
         # the aggregation collective: sum over the (sharded) client axis,
         # then the downlink wire-format transform applied ONCE so the
         # server step and client_post see the same (e.g. re-quantized) sum
-        payload_sum = alg.aggregate_transform(
-            jax.tree.map(lambda p: jnp.sum(p, axis=0), payloads))
+        payload_sum = jax.tree.map(lambda p: jnp.sum(p, axis=0), payloads)
+        if accept is not None:
+            w_total = jnp.sum(weights)
+            w_accept = jnp.sum(weights * accept)
+            # all-rejected rounds contribute a zero payload (server holds)
+            renorm = jnp.where(w_accept > 0.0,
+                               w_total / jnp.maximum(w_accept, 1e-12), 0.0)
+            payload_sum = jax.tree.map(
+                lambda p: p * renorm.astype(p.dtype)
+                if jnp.issubdtype(p.dtype, jnp.floating) else p,
+                payload_sum)
+        payload_sum = alg.aggregate_transform(payload_sum)
 
         new_params, new_opt, new_saux = alg.server_update(
             server.params, server.opt, server.aux, payload_sum,
@@ -405,6 +485,12 @@ class FederatedTrainer:
             on_budgets = jnp.ceil(on_sizes / B).astype(jnp.int32) * E
         else:
             on_budgets = jnp.full(on_sizes.shape, K, jnp.int32)
+        if flt.straggler_rate > 0.0:
+            # mirror the in-loop straggler cut so hooks see the steps
+            # the client actually took
+            on_budgets = jnp.maximum(jnp.ceil(
+                on_budgets.astype(jnp.float32) * plan.budget_scale),
+                1.0).astype(jnp.int32)
         post_aux = jax.vmap(
             lambda d, a, w, p, e, ks: alg.client_post(
                 delta=d, client_aux=a, payload_sum=payload_sum,
@@ -418,17 +504,29 @@ class FederatedTrainer:
             # (model_server = deepcopy(model_client), fedavg.py:97)
             params=jax.vmap(lambda _: new_params)(jnp.arange(self.k_online)))
 
+        # crash chaos: a crashed client's round never happened on its
+        # side — state rolls back to round start, and it reports no
+        # metrics (it is not online this round)
+        online = jnp.ones((self.k_online,))
+        if flt.client_drop_rate > 0.0:
+            new_on_clients = tree_where(plan.survive, new_on_clients,
+                                        on_clients0)
+            online = plan.survive
+
         # scatter online client state back into the full [C] axis
         scatter = lambda full, new: jax.tree.map(
             lambda f, n: f.at[idx].set(n), full, new)
         new_clients = scatter(clients, new_on_clients)
 
-        mask_full = jnp.zeros((C,)).at[idx].set(1.0)
-        loss_full = jnp.zeros((C,)).at[idx].set(losses)
-        acc_full = jnp.zeros((C,)).at[idx].set(accs)
+        mask_full = jnp.zeros((C,)).at[idx].set(online)
+        loss_full = jnp.zeros((C,)).at[idx].set(losses * online)
+        acc_full = jnp.zeros((C,)).at[idx].set(accs * online)
         comm_bytes = jnp.asarray(
             tree_bytes(server.params) * self.k_online
             * alg.payload_scale(), jnp.float32)
+        if flt.client_drop_rate > 0.0:
+            # crashed uploads never hit the wire
+            comm_bytes = comm_bytes * jnp.sum(online) / self.k_online
 
         new_server = ServerState(params=new_params, opt=new_opt,
                                  aux=new_saux, round=server.round + 1,
@@ -436,9 +534,14 @@ class FederatedTrainer:
         # second global phase with data access (DRFA dual update)
         new_server = alg.post_round_global(
             new_server, data, jax.random.fold_in(rng_round, 99))
-        metrics = RoundMetrics(train_loss=loss_full, train_acc=acc_full,
-                               online_mask=mask_full,
-                               comm_bytes=comm_bytes)
+        metrics = RoundMetrics(
+            train_loss=loss_full, train_acc=acc_full,
+            online_mask=mask_full, comm_bytes=comm_bytes,
+            dropped_clients=self.k_online - jnp.sum(online),
+            straggler_clients=jnp.sum(
+                (plan.budget_scale < 1.0).astype(jnp.float32)),
+            rejected_updates=jnp.asarray(rejected, jnp.float32),
+            clipped_updates=jnp.asarray(clipped, jnp.float32))
         return new_server, new_clients, metrics
 
     def mean_client_epoch(self, clients) -> float:

@@ -12,6 +12,7 @@ Multi-host (DCN) initialization mirrors ``dist.init_process_group``
 """
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 import jax
@@ -22,13 +23,58 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from fedtorch_tpu.config import MeshConfig
 
 
-def init_multihost(cfg: MeshConfig) -> None:
-    """DCN bring-up for real pods (no-op for single-process runs)."""
-    if cfg.coordinator_address is not None:
-        jax.distributed.initialize(
-            coordinator_address=cfg.coordinator_address,
-            num_processes=cfg.num_processes,
-            process_id=cfg.process_id)
+def init_multihost(cfg: MeshConfig, *,
+                   timeout_s: Optional[float] = None,
+                   backoff_s: Optional[float] = None,
+                   _sleep=time.sleep) -> None:
+    """DCN bring-up for real pods (no-op for single-process runs).
+
+    Pod bring-up is not atomic: workers boot at different speeds and the
+    coordinator may accept connections seconds after the slowest worker
+    first tries. A single-shot ``jax.distributed.initialize`` turns that
+    skew into a whole-pod launch failure, so transient connect errors are
+    retried with exponential backoff (``cfg.init_backoff_s`` doubling per
+    attempt) until ``cfg.init_timeout_s`` is exhausted, then a clear
+    timeout error names the coordinator instead of whatever socket-level
+    exception the last attempt died with. Deterministic failures —
+    malformed arguments (ValueError/TypeError) or double initialization
+    — fail fast: retrying them would just burn the whole timeout on
+    every host in the pod. ``_sleep`` is injectable for tests."""
+    if cfg.coordinator_address is None:
+        return
+    timeout_s = cfg.init_timeout_s if timeout_s is None else timeout_s
+    backoff_s = cfg.init_backoff_s if backoff_s is None else backoff_s
+    deadline = time.monotonic() + timeout_s
+    attempt = 0
+    while True:
+        try:
+            jax.distributed.initialize(
+                coordinator_address=cfg.coordinator_address,
+                num_processes=cfg.num_processes,
+                process_id=cfg.process_id)
+            return
+        except (ValueError, TypeError):
+            raise  # malformed address/ids — permanent, no retry
+        except Exception as e:
+            msg = str(e).lower()
+            # double jax.distributed.initialize — permanent ("distributed
+            # .initialize should only be called once." in current JAX;
+            # older/newer wordings say "already initialized")
+            if "only be called once" in msg or (
+                    "already" in msg and "initial" in msg):
+                raise
+            attempt += 1
+            delay = backoff_s * (2.0 ** (attempt - 1))
+            if time.monotonic() + delay > deadline:
+                raise RuntimeError(
+                    f"init_multihost: could not reach coordinator "
+                    f"{cfg.coordinator_address!r} within {timeout_s:.0f}s "
+                    f"({attempt} attempt(s); process_id="
+                    f"{cfg.process_id}, num_processes="
+                    f"{cfg.num_processes}). Check that the coordinator "
+                    "process is up and the address/port is reachable "
+                    f"from this host. Last error: {e!r}") from e
+            _sleep(delay)
 
 
 def make_mesh(cfg: MeshConfig, num_clients: Optional[int] = None) -> Mesh:

@@ -1,0 +1,9 @@
+from fedtorch_tpu.robustness.chaos import (  # noqa: F401
+    ChaosPlan, draw_chaos_plan,
+)
+from fedtorch_tpu.robustness.guards import (  # noqa: F401
+    GuardReport, screen_payloads,
+)
+from fedtorch_tpu.robustness.supervisor import (  # noqa: F401
+    RoundSupervisor, SupervisorStats,
+)

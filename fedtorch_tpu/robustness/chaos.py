@@ -1,0 +1,92 @@
+"""Deterministic in-program chaos injection.
+
+The fault schedule is drawn INSIDE the jitted round program from a PRNG
+stream folded off the round key (``fault.chaos_salt``), so
+
+* a seeded run replays the exact same crash/straggler/poison schedule
+  (reproducible chaos — the property real fault drills lack);
+* injection costs nothing when disabled: the engine gates every draw on
+  static config, so the traced program is unchanged with faults off;
+* faults compose with sharding: masks are per-ONLINE-client [k] arrays
+  living in the same vmap/scan the training runs in.
+
+Fault semantics (docs/robustness.md):
+
+* **crash** — fail-stop mid-round: the client's upload never reaches the
+  server (payload masked out of aggregation, surviving weights
+  renormalized by the engine) and its local state rolls back to the
+  round start, exactly as if the process died before its sync.
+* **straggler** — the client misses the round deadline after completing
+  ``ceil(straggler_step_frac * budget)`` of its local steps. This rides
+  the epoch-sync freeze mask: the lockstep scan keeps running but the
+  straggler's state/metrics freeze at the cutoff, and its (partial)
+  update still aggregates — the FedAvg deadline model.
+* **nan poison** — the client uploads a non-finite delta (sensor
+  corruption, fp overflow, or an adversary). The chaos layer injects it
+  at the wire so the server-side guards (guards.py) can be exercised end
+  to end.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from fedtorch_tpu.config import FaultConfig
+
+
+class ChaosPlan(NamedTuple):
+    """Per-online-client fault schedule for one round (all [k])."""
+    survive: jnp.ndarray       # float {0,1}; 0 = crashed mid-round
+    budget_scale: jnp.ndarray  # float (0,1]; <1 = straggler step cut
+    nan_inject: jnp.ndarray    # float {0,1}; 1 = upload poisoned to NaN
+
+
+def no_chaos_plan(k: int) -> ChaosPlan:
+    """The all-healthy plan (faults disabled)."""
+    return ChaosPlan(survive=jnp.ones((k,)),
+                     budget_scale=jnp.ones((k,)),
+                     nan_inject=jnp.zeros((k,)))
+
+
+def draw_chaos_plan(rng: jax.Array, k: int, fault: FaultConfig) -> ChaosPlan:
+    """Draw one round's fault schedule. ``rng`` must already be folded
+    per round (the engine folds ``chaos_salt`` into the round key), so
+    the schedule is a pure function of (seed, round). Each fault class
+    uses an independent fold of the chaos key; rates are static config,
+    so disabled classes trace to constants."""
+    r_crash, r_strag, r_nan = (jax.random.fold_in(rng, i) for i in range(3))
+    if fault.client_drop_rate > 0.0:
+        survive = (jax.random.uniform(r_crash, (k,))
+                   >= fault.client_drop_rate).astype(jnp.float32)
+    else:
+        survive = jnp.ones((k,))
+    if fault.straggler_rate > 0.0:
+        straggler = jax.random.uniform(r_strag, (k,)) < fault.straggler_rate
+        budget_scale = jnp.where(straggler, fault.straggler_step_frac, 1.0)
+    else:
+        budget_scale = jnp.ones((k,))
+    if fault.nan_inject_rate > 0.0:
+        nan_inject = (jax.random.uniform(r_nan, (k,))
+                      < fault.nan_inject_rate).astype(jnp.float32)
+    else:
+        nan_inject = jnp.zeros((k,))
+    return ChaosPlan(survive=survive, budget_scale=budget_scale,
+                     nan_inject=nan_inject)
+
+
+def poison_tree(tree, nan_mask: jnp.ndarray):
+    """Replace the [k]-leading slices selected by ``nan_mask`` with NaN
+    (the poisoned-upload fault). Leaves keep their dtype; integer wire
+    formats (quantized payloads) have no NaN, so they are driven to the
+    dtype's max instead — still a norm explosion the guards catch."""
+    def poison(x):
+        shape = (-1,) + (1,) * (x.ndim - 1)
+        m = nan_mask.reshape(shape).astype(bool)
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            return jnp.where(m, jnp.asarray(jnp.nan, x.dtype), x)
+        if jnp.issubdtype(x.dtype, jnp.integer):
+            return jnp.where(m, jnp.iinfo(x.dtype).max, x)
+        return x
+    return jax.tree.map(poison, tree)

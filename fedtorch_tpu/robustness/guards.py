@@ -1,0 +1,106 @@
+"""Server-side update guards: screen client deltas before aggregation.
+
+The reference aggregates whatever arrives: one NaN client update (fp
+overflow, corrupt wire payload, or a poisoning client) propagates into
+the server model and silently kills the run. These guards screen the
+STACKED per-client deltas inside the jitted round program, before the
+aggregation sum:
+
+* **non-finite rejection** — a delta with any NaN/Inf leaf is always
+  dropped (there is no meaningful way to clip it);
+* **norm screening** — a finite delta whose global l2 norm exceeds
+  ``guard_norm_multiplier`` x the median norm of the surviving finite
+  deltas is dropped (``guard_mode='reject'``) or scaled down onto the
+  threshold (``guard_mode='clip'`` — gradient-clipping semantics, keeps
+  the direction). The median reference makes the threshold scale-free:
+  it tracks the round's natural update magnitude instead of requiring a
+  hand-tuned absolute bound.
+
+Everything is jit-safe (no Python control flow on traced values); the
+engine renormalizes aggregation weights over the accepted clients and
+surfaces the counts in ``RoundMetrics``.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from fedtorch_tpu.config import FaultConfig
+from fedtorch_tpu.core.state import tree_where, tree_zeros_like
+
+
+class GuardReport(NamedTuple):
+    """Per-round guard outcome (all jit-traced)."""
+    accept: jnp.ndarray    # [k] float {0,1}; 1 = payload aggregated
+    rejected: jnp.ndarray  # scalar — candidates dropped (incl. NaN/Inf)
+    clipped: jnp.ndarray   # scalar — candidates norm-clipped
+    norms: jnp.ndarray     # [k] per-client delta l2 norm (NaN if !finite)
+
+
+def client_delta_stats(deltas) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-client (finite, l2-norm) over a [k]-leading delta pytree.
+    Non-float leaves (integer wire formats) are excluded from the norm
+    but still checked for finiteness trivially."""
+    leaves = [x for x in jax.tree.leaves(deltas)
+              if jnp.issubdtype(x.dtype, jnp.floating)]
+    if not leaves:
+        k = jax.tree.leaves(deltas)[0].shape[0]
+        return jnp.ones((k,), bool), jnp.zeros((k,))
+    axes = lambda x: tuple(range(1, x.ndim))
+    finite = jnp.stack([jnp.all(jnp.isfinite(x), axis=axes(x))
+                        for x in leaves]).all(axis=0)
+    sq = sum(jnp.sum(jnp.square(x), axis=axes(x)) for x in leaves)
+    return finite, jnp.sqrt(sq)
+
+
+def screen_payloads(deltas, payloads, survive: jnp.ndarray,
+                    fault: FaultConfig):
+    """Screen the round's client updates.
+
+    ``deltas``: [k] raw (unweighted) client deltas — the semantic object
+    the norms/finiteness are judged on; ``payloads``: [k] wire payloads
+    the verdict is applied to (masked/clipped); ``survive``: [k] chaos
+    crash mask — crashed clients are already out of aggregation and must
+    not influence the median.
+
+    Returns (payloads', GuardReport). ``accept`` EXCLUDES crashed
+    clients, so it is directly the engine's aggregation mask."""
+    finite, norms = client_delta_stats(deltas)
+    alive = survive.astype(bool)
+    candidate = alive & finite
+
+    # median norm over the surviving finite deltas only (others -> NaN
+    # so nanmedian ignores them; an all-NaN median propagates NaN and
+    # every ">" below is False — no norm rejects, which is correct when
+    # nothing survives to define a scale)
+    med = jnp.nanmedian(jnp.where(candidate, norms, jnp.nan))
+    thresh = fault.guard_norm_multiplier * med
+    exploded = candidate & (norms > thresh)
+
+    if fault.guard_mode == "clip":
+        accept = candidate
+        clip_scale = jnp.where(exploded, thresh / jnp.maximum(norms, 1e-30),
+                               1.0)
+        def scale(x):
+            if not jnp.issubdtype(x.dtype, jnp.floating):
+                return x
+            shape = (-1,) + (1,) * (x.ndim - 1)
+            return x * clip_scale.reshape(shape).astype(x.dtype)
+        payloads = jax.tree.map(scale, payloads)
+        clipped = jnp.sum(exploded)
+    else:
+        accept = candidate & ~exploded
+        clipped = jnp.zeros((), jnp.int32)
+
+    # zero out rejected payloads with a select, NOT a multiply — 0 * NaN
+    # is NaN and would defeat the whole guard
+    payloads = tree_where(accept.astype(jnp.float32), payloads,
+                          tree_zeros_like(payloads))
+    rejected = jnp.sum(alive) - jnp.sum(accept)
+    return payloads, GuardReport(
+        accept=accept.astype(jnp.float32),
+        rejected=rejected.astype(jnp.float32),
+        clipped=clipped.astype(jnp.float32),
+        norms=jnp.where(finite, norms, jnp.nan))

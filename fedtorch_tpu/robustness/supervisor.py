@@ -1,0 +1,220 @@
+"""Host-side round supervisor: rollback + retry instead of dying.
+
+The reference's failure story is fail-stop: a diverged model or a dead
+process kills the whole ``mpirun`` job and the operator restarts from
+whatever checkpoint exists. The supervisor wraps
+``FederatedTrainer.run_round`` with production semantics:
+
+1. snapshot the round state (device-level copies — the round jit
+   DONATES its inputs, so the snapshot must own its buffers);
+2. run the round and health-check the result: non-finite server params
+   always count as divergence; with ``fault.loss_blowup_factor > 0`` a
+   mean online loss above that multiple of the running loss EMA does
+   too;
+3. on divergence, roll back to the snapshot and retry with exponential
+   backoff. Each retry folds the attempt number into the server PRNG
+   (``fault.reseed_on_retry``) — a deterministic program replayed
+   unchanged would reproduce the failure, so the retry draws a fresh
+   participation/chaos schedule;
+4. after ``fault.max_retries`` failed retries, degrade gracefully: keep
+   the rolled-back (healthy) state, advance the round counter (the
+   round is SKIPPED, not silently re-run forever), and invoke the
+   ``on_degrade`` hook — the place to e.g. scale the learning rate
+   down or alert an operator.
+
+If the in-memory snapshot is itself sick (the caller handed in diverged
+state), the supervisor falls back to the last on-disk checkpoint when a
+``checkpoint_dir`` is configured (utils/checkpoint.py skips corrupt or
+truncated files instead of raising).
+
+Exceptions from the round program (XLA runtime errors) are retried the
+same way; if EVERY attempt raised — nothing ever produced state to
+health-check — the last exception is re-raised, because skipping a
+round cannot fix a structurally broken program.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from fedtorch_tpu.config import FaultConfig
+from fedtorch_tpu.core.state import RoundMetrics
+from fedtorch_tpu.utils.diagnostics import model_norms
+
+
+def tree_device_copy(tree):
+    """Owning device copies of every leaf — safe to hold across a jit
+    call that donates the originals. Typed PRNG keys can't go through
+    ``jnp.copy``; round-trip their raw key data instead."""
+    def cp(x):
+        dt = getattr(x, "dtype", None)
+        if dt is not None and jnp.issubdtype(dt, jax.dtypes.prng_key):
+            return jax.random.wrap_key_data(
+                jnp.copy(jax.random.key_data(x)),
+                impl=jax.random.key_impl(x))
+        return jnp.copy(x)
+    return jax.tree.map(cp, tree)
+
+
+@dataclasses.dataclass
+class SupervisorStats:
+    """Host-side counters; read them after (or during) training."""
+    rounds: int = 0
+    healthy_rounds: int = 0
+    retries: int = 0
+    rollbacks: int = 0
+    skipped_rounds: int = 0
+    disk_restores: int = 0
+    last_good_round: int = -1
+    loss_ema: Optional[float] = None
+
+
+class RoundSupervisor:
+    """Fault-tolerant wrapper around ``trainer.run_round``.
+
+    Drop-in: ``run_round(server, clients) -> (server, clients, metrics)``
+    with the same donation-friendly contract (the caller's buffers may
+    be consumed). ``on_degrade(server, clients, stats)`` may return a
+    replacement ``(server, clients)`` pair or None to keep the
+    rolled-back state. ``sleep_fn`` is injectable for tests."""
+
+    # healthy-loss EMA smoothing for the blow-up detector
+    EMA_ALPHA = 0.1
+    # PRNG fold base for retry reseeding; far outside the round-index
+    # folds the engine uses on this key
+    RESEED_SALT = 0x5EED0000
+
+    def __init__(self, trainer, fault: Optional[FaultConfig] = None,
+                 checkpoint_dir: Optional[str] = None,
+                 on_degrade: Optional[Callable] = None,
+                 logger=None, sleep_fn: Callable[[float], None] = time.sleep):
+        self.trainer = trainer
+        self.fault = fault if fault is not None else trainer.cfg.fault
+        self.checkpoint_dir = checkpoint_dir
+        self.on_degrade = on_degrade
+        self.logger = logger
+        self.sleep_fn = sleep_fn
+        self.stats = SupervisorStats()
+
+    # -- health ---------------------------------------------------------
+    def _mean_online_loss(self, metrics: RoundMetrics) -> float:
+        n = float(jnp.sum(metrics.online_mask))
+        return float(jnp.sum(metrics.train_loss)) / max(n, 1.0)
+
+    def _healthy(self, server, metrics) -> bool:
+        if not bool(model_norms(server.params)["all_finite"]):
+            return False
+        f = self.fault.loss_blowup_factor
+        if f > 0.0:
+            loss = self._mean_online_loss(metrics)
+            if not jnp.isfinite(loss):
+                return False
+            ema = self.stats.loss_ema
+            if ema is not None and loss > f * ema:
+                return False
+        return True
+
+    def _note_healthy(self, server, metrics) -> None:
+        st = self.stats
+        st.healthy_rounds += 1
+        st.last_good_round = int(server.round) - 1
+        loss = self._mean_online_loss(metrics)
+        if jnp.isfinite(loss):
+            st.loss_ema = loss if st.loss_ema is None else (
+                (1 - self.EMA_ALPHA) * st.loss_ema + self.EMA_ALPHA * loss)
+
+    def _log(self, msg: str) -> None:
+        if self.logger is not None:
+            self.logger.log(msg)
+
+    # -- rollback sources ----------------------------------------------
+    def _restore(self, snapshot):
+        """Fresh copies of the snapshot (each retry's jit call donates
+        what it is handed, so the snapshot itself must never be passed
+        in). Falls back to the on-disk checkpoint if the snapshot is
+        sick — only possible when the caller handed in diverged state."""
+        server, clients = snapshot
+        if bool(model_norms(server.params)["all_finite"]):
+            return tree_device_copy(server), tree_device_copy(clients)
+        if self.checkpoint_dir is not None:
+            from fedtorch_tpu.utils.checkpoint import maybe_resume
+            try:
+                s, c, _, resumed = maybe_resume(
+                    self.checkpoint_dir, tree_device_copy(server),
+                    tree_device_copy(clients), self.trainer.cfg)
+            except FileNotFoundError:
+                resumed = False
+            if resumed:
+                self.stats.disk_restores += 1
+                self._log("supervisor: in-memory snapshot non-finite; "
+                          "restored last on-disk checkpoint "
+                          f"(round {int(s.round)})")
+                return s, c
+        # nothing better exists; hand back the snapshot as-is
+        return tree_device_copy(server), tree_device_copy(clients)
+
+    def _skip_metrics(self) -> RoundMetrics:
+        # [C] metrics use the REAL client count, matching round_fn's
+        # RoundMetrics shapes (stacking per-round histories must work
+        # across healthy and skipped rounds)
+        C = self.trainer.num_clients
+        z = jnp.zeros((C,))
+        s = jnp.zeros(())
+        return RoundMetrics(train_loss=z, train_acc=z, online_mask=z,
+                            comm_bytes=s, dropped_clients=s,
+                            straggler_clients=s, rejected_updates=s,
+                            clipped_updates=s)
+
+    # -- the supervised round -------------------------------------------
+    def run_round(self, server, clients):
+        flt = self.fault
+        self.stats.rounds += 1
+        snapshot = (tree_device_copy(server), tree_device_copy(clients))
+        round_idx = int(server.round)
+        last_exc: Optional[Exception] = None
+        produced_state = False
+
+        for attempt in range(flt.max_retries + 1):
+            try:
+                out_s, out_c, metrics = self.trainer.run_round(
+                    server, clients)
+                jax.block_until_ready(out_s.params)
+                produced_state = True
+                if self._healthy(out_s, metrics):
+                    self._note_healthy(out_s, metrics)
+                    return out_s, out_c, metrics
+                why = "non-finite server params or loss blow-up"
+            except Exception as e:  # XLA runtime / dispatch failures
+                last_exc = e
+                why = f"round program raised: {e!r}"
+
+            self.stats.rollbacks += 1
+            server, clients = self._restore(snapshot)
+            self._log(f"supervisor: round {round_idx} attempt "
+                      f"{attempt + 1}/{flt.max_retries + 1} diverged "
+                      f"({why}); rolled back")
+            if attempt < flt.max_retries:
+                self.stats.retries += 1
+                self.sleep_fn(flt.backoff_base_s * (2.0 ** attempt))
+                if flt.reseed_on_retry:
+                    server = server._replace(rng=jax.random.fold_in(
+                        server.rng, self.RESEED_SALT + attempt + 1))
+
+        if not produced_state and last_exc is not None:
+            # every attempt raised — a broken program, not divergence
+            raise last_exc
+
+        # degrade: keep the healthy rolled-back state, skip the round
+        self.stats.skipped_rounds += 1
+        server = server._replace(round=server.round + 1)
+        self._log(f"supervisor: round {round_idx} skipped after "
+                  f"{flt.max_retries + 1} attempts; state rolled back")
+        if self.on_degrade is not None:
+            replaced = self.on_degrade(server, clients, self.stats)
+            if replaced is not None:
+                server, clients = replaced
+        return server, clients, self._skip_metrics()

@@ -17,9 +17,11 @@ and round counter — is serialized, so a resumed run continues exactly.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
 import os
 import time
+import warnings
 
 import numpy as np
 from typing import Optional, Tuple
@@ -120,6 +122,40 @@ def _snapshot(server, clients, cfg: ExperimentConfig):
                         jax.tree.map(to_host, state))
 
 
+# self-describing checkpoint framing: magic + payload length + sha256
+# prepended to the flax payload in the SAME file, so the integrity
+# record can never go stale relative to its payload (a cross-file
+# record — e.g. in checkpoint.json — has a crash window between the two
+# atomic writes, and describes only the latest checkpoint, not the
+# per-round keeps). Legacy unframed checkpoints are still readable.
+_CKPT_MAGIC = b"FTCK1\x00"
+_CKPT_HEADER = len(_CKPT_MAGIC) + 8 + 32
+
+
+def _frame_payload(payload: bytes) -> bytes:
+    return (_CKPT_MAGIC + len(payload).to_bytes(8, "big")
+            + hashlib.sha256(payload).digest() + payload)
+
+
+def _unframe_payload(blob: bytes):
+    """Returns (payload, why_corrupt). ``why_corrupt`` is None for a
+    verified frame AND for legacy unframed blobs (no record to check —
+    deserialization is their only guard)."""
+    if not blob.startswith(_CKPT_MAGIC):
+        return blob, None
+    if len(blob) < _CKPT_HEADER:
+        return None, "truncated header"
+    want_len = int.from_bytes(blob[6:14], "big")
+    digest = blob[14:_CKPT_HEADER]
+    payload = blob[_CKPT_HEADER:]
+    if len(payload) != want_len:
+        return None, (f"{len(payload)} payload bytes on disk, expected "
+                      f"{want_len} (truncated write?)")
+    if hashlib.sha256(payload).digest() != digest:
+        return None, "sha256 mismatch (bit rot or torn write)"
+    return payload, None
+
+
 def _atomic_write(path: str, data: bytes) -> None:
     """tmp + fsync + rename so a crash (including power loss — without
     the fsync, delayed allocation could rename before the data blocks
@@ -140,7 +176,10 @@ def _write_checkpoint(directory: str, host_state, meta: dict,
     """Serialize + write an already-host-resident snapshot (the worker
     half of both the sync and async paths)."""
     os.makedirs(directory, exist_ok=True)
-    payload = serialization.to_bytes(host_state)
+    # framed payload: resume verifies the in-file length + digest BEFORE
+    # trying to deserialize, so a torn/truncated/bit-rotted file is
+    # detected cleanly instead of surfacing as an opaque msgpack error
+    payload = _frame_payload(serialization.to_bytes(host_state))
     path = os.path.join(directory, "checkpoint.ckpt")
     _atomic_write(path, payload)
     meta_bytes = json.dumps(meta, default=str).encode()
@@ -270,12 +309,31 @@ class AsyncCheckpointer:
             self._thread.join(timeout=30)
 
 
+def _corrupt_skip(path: str, why: str, server, clients):
+    """A corrupt/truncated checkpoint is a recoverable condition (a
+    crash mid-write before the atomic rename existed, bit rot, a torn
+    copy): warn and start fresh instead of dying on an opaque
+    deserialization error."""
+    warnings.warn(
+        f"checkpoint at {path} is corrupt or truncated ({why}); "
+        "skipping resume and starting from the initialized state",
+        RuntimeWarning, stacklevel=3)
+    return server, clients, 0.0, False
+
+
 def maybe_resume(directory: Optional[str], server, clients,
                  cfg: ExperimentConfig,
                  checkpoint_index: Optional[str] = None):
     """Restore full state into freshly-initialized pytrees; validates the
     config compatibility rules of checkpoint.py:93-139. Returns
-    (server, clients, best_prec1, resumed: bool)."""
+    (server, clients, best_prec1, resumed: bool).
+
+    Corrupt or truncated checkpoints (payload length/sha256 mismatch
+    against the in-file integrity frame, undecodable meta JSON, or a
+    payload that fails to deserialize) are detected and SKIPPED with a
+    warning — a MISSING checkpoint/meta file or config INCOMPATIBILITY
+    still raises, because silently ignoring a wrong ``--resume`` target
+    would be data loss."""
     if directory is None:
         return server, clients, 0.0, False
     name = "checkpoint.ckpt" if checkpoint_index is None \
@@ -286,8 +344,14 @@ def maybe_resume(directory: Optional[str], server, clients,
         if checkpoint_index is None else "checkpoint.json")
     if not os.path.exists(path):
         raise FileNotFoundError(f"No checkpoint at {path}")
-    with open(meta_path) as f:
-        meta = json.load(f)
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+    except json.JSONDecodeError as e:
+        # undecodable content is corruption; a MISSING meta file is an
+        # operator error and propagates as FileNotFoundError above/here
+        return _corrupt_skip(meta_path, f"undecodable meta JSON: {e}",
+                             server, clients)
     old = meta["arguments"]
     new = _compat_meta(cfg)
     for key in ("dataset", "batch_size", "arch", "algorithm",
@@ -303,9 +367,21 @@ def maybe_resume(directory: Optional[str], server, clients,
             f"({old['num_epochs']} -> {new['num_epochs']})")
     C = cfg.federated.num_clients
     with open(path, "rb") as f:
+        blob = f.read()
+    # in-file integrity frame first (cheap, precise diagnosis — and
+    # valid for per-round keeps too, since every file carries its own
+    # record); legacy unframed checkpoints fall through to the
+    # deserialization try below
+    payload, why = _unframe_payload(blob)
+    if why is not None:
+        return _corrupt_skip(path, why, server, clients)
+    try:
         restored = serialization.from_bytes(
             {"server": _unkey(server),
-             "clients": _strip_padding(clients, C)}, f.read())
+             "clients": _strip_padding(clients, C)}, payload)
+    except Exception as e:  # msgpack/flax raise various concrete types
+        return _corrupt_skip(path, f"deserialization failed: {e}",
+                             server, clients)
     # graft the restored real clients back into the (possibly padded)
     # freshly-initialized template, preserving its sharding layout
     new_clients = jax.tree.map(lambda full, real: full.at[:C].set(real),

@@ -25,11 +25,20 @@ import jax.numpy as jnp
 
 @jax.jit
 def model_norms(params) -> Dict[str, jnp.ndarray]:
-    """Global l2 norm + per-leaf max abs (check_training.py:22-37)."""
+    """Global l2 norm + per-leaf max abs (check_training.py:22-37) +
+    a jit-safe ``all_finite`` flag (the divergence signal the round
+    supervisor polls — one fused device program, no per-leaf host
+    round-trips). An empty pytree is trivially finite with zero norm
+    (a structural no-params edge case, not an error)."""
     leaves = jax.tree.leaves(params)
+    if not leaves:
+        return {"l2": jnp.zeros(()), "max_abs": jnp.zeros(()),
+                "all_finite": jnp.asarray(True)}
     sq = sum(jnp.sum(jnp.square(x)) for x in leaves)
     mx = jnp.max(jnp.stack([jnp.max(jnp.abs(x)) for x in leaves]))
-    return {"l2": jnp.sqrt(sq), "max_abs": mx}
+    finite = jnp.stack(
+        [jnp.all(jnp.isfinite(x)) for x in leaves]).all()
+    return {"l2": jnp.sqrt(sq), "max_abs": mx, "all_finite": finite}
 
 
 @jax.jit
@@ -52,6 +61,6 @@ def aggregation_tracking(old_params, new_params) -> Dict[str, jnp.ndarray]:
 
 def check_finite(params) -> bool:
     """Divergence guard: all leaves finite (the implicit check the
-    reference's norm prints served)."""
-    return all(bool(jnp.all(jnp.isfinite(x)))
-               for x in jax.tree.leaves(params))
+    reference's norm prints served). Host-side convenience wrapper over
+    :func:`model_norms`' fused device check."""
+    return bool(model_norms(params)["all_finite"])

@@ -1,0 +1,142 @@
+"""Chaos suite: short FedAvg + SCAFFOLD synthetic jobs under a fault
+schedule, asserting the faulted run stays within an accuracy tolerance
+of the fault-free run (ISSUE 1 acceptance: drop_rate=0.25 must complete
+every round host-exception-free with final top-1 within 5 points).
+
+Each algorithm trains twice from the same seed — once fault-free, once
+under the chaos schedule (client crashes + stragglers + NaN-poisoned
+uploads with the update guards on, all deterministic under the threaded
+PRNG) — and the gap in final test accuracy is checked against the
+tolerance. The supervisor wraps the faulted run, so a diverged round
+would roll back instead of killing the job.
+
+Registered as a `slow`-marked pytest (tests/test_chaos_suite.py) so the
+tier-1 fast lane stays fast. Standalone usage:
+
+    python scripts/chaos_suite.py [--rounds N] [--smoke] [--tol PTS]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def run_suite(rounds: int = 20, smoke: bool = False, tol_points: float = 5.0,
+              algorithms=("fedavg", "scaffold"), seed: int = 0) -> dict:
+    """Returns the suite report; raises AssertionError on a tolerance
+    breach (the pytest wrapper surfaces it directly)."""
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import jax.numpy as jnp
+
+    from fedtorch_tpu.algorithms import make_algorithm
+    from fedtorch_tpu.config import (
+        DataConfig, ExperimentConfig, FaultConfig, FederatedConfig,
+        ModelConfig, OptimConfig, TrainConfig,
+    )
+    from fedtorch_tpu.data import build_federated_data
+    from fedtorch_tpu.models import define_model
+    from fedtorch_tpu.parallel import FederatedTrainer, evaluate
+    from fedtorch_tpu.robustness import RoundSupervisor
+
+    C = 8 if smoke else 16
+    B = 16 if smoke else 32
+    K = 3 if smoke else 5
+    rounds = max(rounds, 4)
+
+    fault_schedule = FaultConfig(
+        client_drop_rate=0.25, straggler_rate=0.25,
+        straggler_step_frac=0.5, nan_inject_rate=0.1,
+        guard_updates=True, max_retries=2, backoff_base_s=0.0)
+
+    def one_run(algorithm: str, fault: FaultConfig):
+        cfg = ExperimentConfig(
+            data=DataConfig(dataset="synthetic", synthetic_dim=30,
+                            batch_size=B, synthetic_alpha=0.5,
+                            synthetic_beta=0.5),
+            federated=FederatedConfig(
+                federated=True, num_clients=C, num_comms=rounds,
+                online_client_rate=1.0, algorithm=algorithm,
+                sync_type="local_step"),
+            model=ModelConfig(arch="logistic_regression"),
+            optim=OptimConfig(lr=0.5, weight_decay=0.0),
+            train=TrainConfig(local_step=K),
+            fault=fault,
+        ).finalize()
+        data = build_federated_data(cfg)
+        model = define_model(cfg, batch_size=B)
+        trainer = FederatedTrainer(cfg, model, make_algorithm(cfg),
+                                   data.train)
+        server, clients = trainer.init_state(jax.random.key(seed))
+        sup = RoundSupervisor(trainer, sleep_fn=lambda s: None)
+        counters = {"dropped": 0.0, "stragglers": 0.0, "rejected": 0.0}
+        for _ in range(rounds):
+            server, clients, m = sup.run_round(server, clients)
+            counters["dropped"] += float(m.dropped_clients)
+            counters["stragglers"] += float(m.straggler_clients)
+            counters["rejected"] += float(m.rejected_updates)
+        assert all(bool(jnp.all(jnp.isfinite(x)))
+                   for x in jax.tree.leaves(server.params)), \
+            f"{algorithm}: non-finite server params survived the guards"
+        res = evaluate(model, server.params, data.test_x, data.test_y)
+        return float(res.top1), counters, sup.stats
+
+    report = {"rounds": rounds, "clients": C, "tol_points": tol_points,
+              "fault": {"client_drop_rate": 0.25, "straggler_rate": 0.25,
+                        "nan_inject_rate": 0.1, "guard": "reject"},
+              "algorithms": {}}
+    t0 = time.time()
+    for algorithm in algorithms:
+        clean_acc, _, _ = one_run(algorithm, FaultConfig())
+        chaos_acc, counters, stats = one_run(algorithm, fault_schedule)
+        gap = (clean_acc - chaos_acc) * 100.0
+        entry = {
+            "clean_top1": round(clean_acc, 4),
+            "chaos_top1": round(chaos_acc, 4),
+            "gap_points": round(gap, 2),
+            "faults_injected": {k: int(v) for k, v in counters.items()},
+            "supervisor": {"rollbacks": stats.rollbacks,
+                           "skipped_rounds": stats.skipped_rounds},
+        }
+        report["algorithms"][algorithm] = entry
+        log(f"{algorithm}: clean {clean_acc:.4f} chaos {chaos_acc:.4f} "
+            f"gap {gap:+.2f}pts faults {entry['faults_injected']}")
+        assert counters["dropped"] > 0, \
+            f"{algorithm}: chaos schedule injected no crashes"
+        assert counters["rejected"] > 0, \
+            f"{algorithm}: guards rejected nothing despite NaN injection"
+        assert gap <= tol_points, (
+            f"{algorithm}: chaos run lost {gap:.2f} accuracy points "
+            f"(tolerance {tol_points}); robustness regression")
+    report["wall_seconds"] = round(time.time() - t0, 1)
+    return report
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=20)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shapes for CI")
+    ap.add_argument("--tol", type=float, default=5.0,
+                    help="max accuracy-point gap vs the fault-free run")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    report = run_suite(rounds=args.rounds, smoke=args.smoke,
+                       tol_points=args.tol, seed=args.seed)
+    print(json.dumps(report), flush=True)
+
+
+if __name__ == "__main__":
+    main()

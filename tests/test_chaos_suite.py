@@ -1,0 +1,21 @@
+"""Slow-lane chaos suite: FedAvg + SCAFFOLD under the standard fault
+schedule must stay within tolerance of the fault-free run
+(scripts/chaos_suite.py; ISSUE 1 acceptance criteria)."""
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "scripts"))
+
+
+@pytest.mark.slow
+def test_chaos_suite_within_tolerance():
+    from chaos_suite import run_suite
+    report = run_suite(rounds=12, smoke=True, tol_points=5.0)
+    for algorithm, entry in report["algorithms"].items():
+        assert entry["gap_points"] <= 5.0
+        assert entry["faults_injected"]["dropped"] > 0
+        assert entry["faults_injected"]["rejected"] > 0

@@ -1,0 +1,586 @@
+"""Fault-tolerance tests (ISSUE 1): deterministic chaos schedules,
+crash masking + weight renormalization, straggler step cuts, update
+guards (NaN rejection / norm clipping), supervisor rollback semantics,
+crash-safe checkpoint resume, and multihost init retry."""
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from fedtorch_tpu.algorithms import make_algorithm
+from fedtorch_tpu.config import (
+    DataConfig, ExperimentConfig, FaultConfig, FederatedConfig, MeshConfig,
+    ModelConfig, OptimConfig, TrainConfig,
+)
+from fedtorch_tpu.data import build_federated_data
+from fedtorch_tpu.models import define_model
+from fedtorch_tpu.parallel import FederatedTrainer
+from fedtorch_tpu.robustness import RoundSupervisor, draw_chaos_plan
+from fedtorch_tpu.robustness.chaos import poison_tree
+from fedtorch_tpu.robustness.guards import screen_payloads
+from fedtorch_tpu.utils.diagnostics import model_norms
+
+
+def make_trainer(fault=None, algorithm="fedavg", num_clients=8, rate=1.0,
+                 lr=0.1, local_step=3, sync_type="local_step"):
+    cfg = ExperimentConfig(
+        data=DataConfig(dataset="synthetic", synthetic_dim=20,
+                        batch_size=32, synthetic_alpha=0.5,
+                        synthetic_beta=0.5),
+        federated=FederatedConfig(
+            federated=True, num_clients=num_clients, num_comms=20,
+            online_client_rate=rate, algorithm=algorithm,
+            sync_type=sync_type),
+        model=ModelConfig(arch="logistic_regression"),
+        optim=OptimConfig(lr=lr, weight_decay=0.0),
+        train=TrainConfig(local_step=local_step),
+        fault=fault if fault is not None else FaultConfig(),
+    ).finalize()
+    data = build_federated_data(cfg)
+    model = define_model(cfg, batch_size=cfg.data.batch_size)
+    return FederatedTrainer(cfg, model, make_algorithm(cfg), data.train)
+
+
+def all_finite(tree) -> bool:
+    return all(bool(jnp.all(jnp.isfinite(x)))
+               for x in jax.tree.leaves(tree))
+
+
+# -- chaos schedule ---------------------------------------------------------
+class TestChaosDeterminism:
+    def test_same_key_same_plan(self):
+        flt = FaultConfig(client_drop_rate=0.3, straggler_rate=0.3,
+                          nan_inject_rate=0.2)
+        a = draw_chaos_plan(jax.random.key(3), 16, flt)
+        b = draw_chaos_plan(jax.random.key(3), 16, flt)
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    def test_different_keys_differ(self):
+        flt = FaultConfig(client_drop_rate=0.5)
+        plans = [np.asarray(draw_chaos_plan(jax.random.key(s), 64,
+                                            flt).survive)
+                 for s in range(4)]
+        assert any(not np.array_equal(plans[0], p) for p in plans[1:])
+
+    def test_disabled_classes_are_constant(self):
+        plan = draw_chaos_plan(jax.random.key(0), 8, FaultConfig())
+        np.testing.assert_array_equal(np.asarray(plan.survive), np.ones(8))
+        np.testing.assert_array_equal(np.asarray(plan.budget_scale),
+                                      np.ones(8))
+        np.testing.assert_array_equal(np.asarray(plan.nan_inject),
+                                      np.zeros(8))
+
+    def test_round_replay_is_bit_exact(self):
+        """Two trainers with the same seed replay the identical fault
+        schedule AND the identical numerics."""
+        flt = FaultConfig(client_drop_rate=0.3, straggler_rate=0.3,
+                          nan_inject_rate=0.1, guard_updates=True)
+        outs = []
+        for _ in range(2):
+            t = make_trainer(fault=flt)
+            s, c = t.init_state(jax.random.key(5))
+            for _ in range(3):
+                s, c, m = t.run_round(s, c)
+            outs.append((jax.tree.map(np.asarray, s.params),
+                         float(m.dropped_clients),
+                         float(m.rejected_updates)))
+        p0, p1 = outs[0][0], outs[1][0]
+        for a, b in zip(jax.tree.leaves(p0), jax.tree.leaves(p1)):
+            np.testing.assert_array_equal(a, b)
+        assert outs[0][1:] == outs[1][1:]
+
+
+# -- crash masking ----------------------------------------------------------
+class TestCrashInjection:
+    def test_all_crash_round_is_a_noop(self):
+        t = make_trainer(fault=FaultConfig(client_drop_rate=1.0))
+        s, c = t.init_state(jax.random.key(0))
+        p0 = jax.tree.map(np.asarray, s.params)
+        c0 = jax.tree.map(np.asarray, c)
+        s2, c2, m = t.run_round(s, c)
+        for a, b in zip(jax.tree.leaves(p0), jax.tree.leaves(s2.params)):
+            np.testing.assert_array_equal(a, np.asarray(b))
+        # crashed clients roll back to round start (fail-stop)
+        for a, b in zip(jax.tree.leaves(c0), jax.tree.leaves(
+                jax.tree.map(np.asarray, c2))):
+            np.testing.assert_array_equal(a, b)
+        assert float(m.dropped_clients) == t.k_online
+        assert float(m.online_mask.sum()) == 0.0
+        assert float(m.comm_bytes) == 0.0
+
+    def test_partial_crash_training_continues(self):
+        """drop_rate=0.25: all rounds complete host-exception-free,
+        metrics report drops, server stays finite and still learns."""
+        t = make_trainer(fault=FaultConfig(client_drop_rate=0.25), lr=0.5)
+        s, c = t.init_state(jax.random.key(1))
+        dropped = 0.0
+        first = last = None
+        for r in range(12):
+            s, c, m = t.run_round(s, c)
+            dropped += float(m.dropped_clients)
+            n = max(float(m.online_mask.sum()), 1.0)
+            loss = float(m.train_loss.sum()) / n
+            first = loss if first is None else first
+            last = loss
+        assert dropped > 0
+        assert all_finite(s.params)
+        assert last < first  # still converging through the chaos
+
+    def test_survivor_weights_renormalized(self):
+        """One local step, linear model: the server update must equal the
+        SURVIVOR-average delta with the fault-free total weight mass —
+        i.e. dropping clients must not shrink the server step toward 0."""
+        flt = FaultConfig(client_drop_rate=0.45)
+        t = make_trainer(fault=flt, local_step=1, lr=0.1)
+        s, c = t.init_state(jax.random.key(2))
+        p0 = jax.tree.map(np.asarray, s.params)
+        s2, _, m = t.run_round(s, c)
+        n_online = float(m.online_mask.sum())
+        assert 0 < n_online < t.k_online  # the draw dropped some, not all
+        # fault-free reference run from the same init (capture before
+        # run_round — the round jit donates its input buffers)
+        t_ref = make_trainer(local_step=1, lr=0.1)
+        s_ref, c_ref = t_ref.init_state(jax.random.key(2))
+        p0_ref = jax.tree.map(np.asarray, s_ref.params)
+        s_ref2, _, _ = t_ref.run_round(s_ref, c_ref)
+        # per-leaf: ||update_chaos|| must be the same order as the
+        # fault-free update (renormalized), NOT scaled by survivors/k
+        upd = np.concatenate([
+            (np.asarray(b) - a).ravel()
+            for a, b in zip(jax.tree.leaves(p0),
+                            jax.tree.leaves(s2.params))])
+        upd_ref = np.concatenate([
+            (np.asarray(b) - a).ravel()
+            for a, b in zip(jax.tree.leaves(p0_ref),
+                            jax.tree.leaves(s_ref2.params))])
+        ratio = np.linalg.norm(upd) / np.linalg.norm(upd_ref)
+        assert 0.5 < ratio < 2.0  # renormalized, not survivors/k ~ 0.5-
+
+
+# -- stragglers -------------------------------------------------------------
+class TestStragglers:
+    def test_step_budget_cut(self):
+        flt = FaultConfig(straggler_rate=0.5, straggler_step_frac=0.34)
+        t = make_trainer(fault=flt, local_step=3)
+        s, c = t.init_state(jax.random.key(0))
+        s, c, m = t.run_round(s, c)
+        li = np.asarray(c.local_index)[:t.num_clients]
+        # ceil(3 * 0.34) = 2 for stragglers, 3 for the rest
+        assert set(li.tolist()) <= {2, 3}
+        n_strag = int(np.sum(li == 2))
+        assert n_strag == int(float(m.straggler_clients))
+        assert n_strag > 0
+
+    def test_straggler_partial_update_aggregates(self):
+        flt = FaultConfig(straggler_rate=1.0, straggler_step_frac=0.5)
+        t = make_trainer(fault=flt, local_step=4)
+        s, c = t.init_state(jax.random.key(3))
+        p0 = jax.tree.map(np.asarray, s.params)
+        s2, c2, m = t.run_round(s, c)
+        # everyone straggled at 2/4 steps, but partial updates still move
+        # the server
+        assert float(m.straggler_clients) == t.k_online
+        assert any(np.abs(a - np.asarray(b)).max() > 0
+                   for a, b in zip(jax.tree.leaves(p0),
+                                   jax.tree.leaves(s2.params)))
+        np.testing.assert_array_equal(
+            np.asarray(c2.local_index)[:t.num_clients], 2)
+
+
+# -- update guards ----------------------------------------------------------
+class TestUpdateGuards:
+    def _stack(self, vals):
+        return {"w": jnp.asarray(vals, jnp.float32)}
+
+    def test_nonfinite_rejected(self):
+        deltas = self._stack([[1., 1.], [jnp.nan, 1.], [1., 2.]])
+        flt = FaultConfig(guard_updates=True)
+        payloads, rep = screen_payloads(deltas, deltas, jnp.ones(3), flt)
+        np.testing.assert_array_equal(np.asarray(rep.accept), [1, 0, 1])
+        assert float(rep.rejected) == 1.0
+        assert all_finite(payloads)  # NaN payload zeroed by select
+
+    def test_norm_explosion_rejected_and_clipped(self):
+        deltas = self._stack([[1., 0.], [0., 1.], [1., 1.], [500., 0.]])
+        flt = FaultConfig(guard_updates=True, guard_norm_multiplier=10.0)
+        _, rep = screen_payloads(deltas, deltas, jnp.ones(4), flt)
+        np.testing.assert_array_equal(np.asarray(rep.accept), [1, 1, 1, 0])
+        # clip mode keeps it, scaled onto the threshold
+        flt_clip = FaultConfig(guard_updates=True,
+                               guard_norm_multiplier=10.0,
+                               guard_mode="clip")
+        payloads, rep2 = screen_payloads(deltas, deltas, jnp.ones(4),
+                                         flt_clip)
+        np.testing.assert_array_equal(np.asarray(rep2.accept), [1, 1, 1, 1])
+        assert float(rep2.clipped) == 1.0
+        clipped_norm = float(jnp.linalg.norm(payloads["w"][3]))
+        med = float(np.median([1.0, 1.0, np.sqrt(2.0), 500.0]))
+        assert clipped_norm == pytest.approx(10.0 * med, rel=1e-5)
+
+    def test_crashed_clients_excluded_from_median(self):
+        # the huge delta survives; the crashed moderate ones must not
+        # drag the median up (or down) — only survivors define scale
+        deltas = self._stack([[1., 0.], [0., 1.], [1., 1.], [500., 0.]])
+        flt = FaultConfig(guard_updates=True, guard_norm_multiplier=10.0)
+        survive = jnp.asarray([1., 1., 1., 0.])
+        _, rep = screen_payloads(deltas, deltas, survive, flt)
+        # client 3 crashed (not "rejected"); others accepted
+        np.testing.assert_array_equal(np.asarray(rep.accept), [1, 1, 1, 0])
+        assert float(rep.rejected) == 0.0
+
+    def test_nan_delta_rejected_server_stays_finite(self):
+        """End to end: a forced-NaN upload is rejected by the guard and
+        the server state stays finite (the acceptance scenario)."""
+        flt = FaultConfig(nan_inject_rate=0.4, guard_updates=True)
+        t = make_trainer(fault=flt)
+        s, c = t.init_state(jax.random.key(0))
+        rejected = 0.0
+        for _ in range(5):
+            s, c, m = t.run_round(s, c)
+            rejected += float(m.rejected_updates)
+            assert all_finite(s.params)
+            assert all_finite(s.opt)
+        assert rejected > 0
+
+    def test_nan_inject_keeps_delta_stateful_aux_finite(self):
+        """Regression: the wire-level poison must NOT leak into
+        client_post's persistent aux updates (FedGATE's tracking variate
+        consumes the round delta) — a one-round wire fault must not kill
+        the client forever."""
+        flt = FaultConfig(nan_inject_rate=0.5, guard_updates=True)
+        t = make_trainer(fault=flt, algorithm="fedgate")
+        s, c = t.init_state(jax.random.key(0))
+        rejected = 0.0
+        for _ in range(4):
+            s, c, m = t.run_round(s, c)
+            rejected += float(m.rejected_updates)
+            assert all_finite(s.params)
+            assert all_finite(c.aux)  # tracking/memory stay sane
+        assert rejected > 0
+
+    def test_nan_delta_without_guard_poisons_server(self):
+        """Negative control: the same fault with guards OFF does poison
+        the server — the guard is what saves it, not an accident."""
+        t = make_trainer(fault=FaultConfig(nan_inject_rate=1.0))
+        s, c = t.init_state(jax.random.key(0))
+        s, c, _ = t.run_round(s, c)
+        assert not all_finite(s.params)
+
+    def test_poison_tree_dtypes(self):
+        tree = {"f": jnp.ones((3, 2)), "i": jnp.ones((3, 2), jnp.int32)}
+        out = poison_tree(tree, jnp.asarray([0., 1., 0.]))
+        f = np.asarray(out["f"])
+        assert np.all(np.isfinite(f[[0, 2]]))
+        assert np.all(np.isnan(f[1]))
+        assert int(out["i"][1, 0]) == np.iinfo(np.int32).max
+
+
+# -- supervisor -------------------------------------------------------------
+class TestSupervisor:
+    def test_rollback_restores_pre_round_state_bit_exactly(self):
+        flt = FaultConfig(nan_inject_rate=1.0, max_retries=2,
+                          backoff_base_s=0.0)
+        t = make_trainer(fault=flt)
+        sup = RoundSupervisor(t, sleep_fn=lambda s: None)
+        s, c = t.init_state(jax.random.key(0))
+        p0 = jax.tree.map(np.asarray, s.params)
+        o0 = jax.tree.map(np.asarray, s.opt)
+        s2, c2, m = sup.run_round(s, c)
+        for a, b in zip(jax.tree.leaves(p0), jax.tree.leaves(s2.params)):
+            np.testing.assert_array_equal(a, np.asarray(b))
+        for a, b in zip(jax.tree.leaves(o0), jax.tree.leaves(s2.opt)):
+            np.testing.assert_array_equal(a, np.asarray(b))
+        # round advanced past the skipped round; retries all burned
+        assert int(s2.round) == 1
+        assert sup.stats.skipped_rounds == 1
+        assert sup.stats.retries == flt.max_retries
+        assert float(m.online_mask.sum()) == 0.0
+
+    def test_forced_divergence_exactly_one_rollback_and_retry(self):
+        """First attempt diverges (stubbed NaN), retry succeeds: exactly
+        one rollback + one retry, round completes healthy."""
+        t = make_trainer()
+        orig = t.run_round
+        calls = {"n": 0}
+
+        def flaky(server, clients):
+            s, c, m = orig(server, clients)
+            calls["n"] += 1
+            if calls["n"] == 1:
+                s = s._replace(params=jax.tree.map(
+                    lambda x: x * jnp.nan, s.params))
+            return s, c, m
+
+        t.run_round = flaky
+        sup = RoundSupervisor(t, fault=FaultConfig(max_retries=2,
+                                                   backoff_base_s=0.0),
+                              sleep_fn=lambda s: None)
+        s, c = t.init_state(jax.random.key(0))
+        s2, c2, m = sup.run_round(s, c)
+        assert sup.stats.rollbacks == 1
+        assert sup.stats.retries == 1
+        assert sup.stats.skipped_rounds == 0
+        assert all_finite(s2.params)
+        assert int(s2.round) == 1
+        assert calls["n"] == 2
+
+    def test_skip_metrics_match_round_metric_shapes(self):
+        """Skipped rounds must return [num_clients] metrics exactly like
+        healthy rounds, even when the client axis is padded for the
+        mesh — stacking a per-round history must never shape-error."""
+        flt = FaultConfig(nan_inject_rate=1.0, max_retries=0,
+                          backoff_base_s=0.0)
+        t = make_trainer(fault=flt, num_clients=10)  # 8-dev mesh pads
+        assert t.padded_clients > t.num_clients
+        sup = RoundSupervisor(t, sleep_fn=lambda s: None)
+        s, c = t.init_state(jax.random.key(0))
+        s, c, m_skip = sup.run_round(s, c)
+        assert sup.stats.skipped_rounds == 1
+        assert m_skip.online_mask.shape == (t.num_clients,)
+        assert m_skip.train_loss.shape == (t.num_clients,)
+
+    def test_healthy_rounds_pass_through(self):
+        t = make_trainer()
+        sup = RoundSupervisor(t, sleep_fn=lambda s: None)
+        s, c = t.init_state(jax.random.key(0))
+        for _ in range(3):
+            s, c, m = sup.run_round(s, c)
+        assert sup.stats.rollbacks == 0
+        assert sup.stats.healthy_rounds == 3
+        assert sup.stats.loss_ema is not None
+        assert int(s.round) == 3
+
+    def test_loss_blowup_detection(self):
+        """A loss far above the EMA triggers rollback even with finite
+        params."""
+        t = make_trainer()
+        orig = t.run_round
+        calls = {"n": 0}
+
+        def blowup(server, clients):
+            s, c, m = orig(server, clients)
+            calls["n"] += 1
+            if calls["n"] == 2:  # second round: loss explodes
+                m = m._replace(train_loss=m.train_loss * 1e6)
+            return s, c, m
+
+        t.run_round = blowup
+        sup = RoundSupervisor(
+            t, fault=FaultConfig(loss_blowup_factor=10.0, max_retries=1,
+                                 backoff_base_s=0.0),
+            sleep_fn=lambda s: None)
+        s, c = t.init_state(jax.random.key(0))
+        s, c, _ = sup.run_round(s, c)     # healthy, seeds the EMA
+        s, c, _ = sup.run_round(s, c)     # blow-up -> rollback, retry ok
+        assert sup.stats.rollbacks == 1
+        assert sup.stats.healthy_rounds == 2
+
+    def test_persistent_exception_reraises(self):
+        t = make_trainer()
+
+        def boom(server, clients):
+            raise RuntimeError("xla exploded")
+
+        t.run_round = boom
+        sup = RoundSupervisor(t, fault=FaultConfig(max_retries=1,
+                                                   backoff_base_s=0.0),
+                              sleep_fn=lambda s: None)
+        s, c = t.init_state(jax.random.key(0))
+        with pytest.raises(RuntimeError, match="xla exploded"):
+            sup.run_round(s, c)
+
+
+# -- diagnostics ------------------------------------------------------------
+class TestDiagnostics:
+    def test_model_norms_all_finite_flag(self):
+        out = model_norms({"w": jnp.ones((3,))})
+        assert bool(out["all_finite"])
+        out = model_norms({"w": jnp.asarray([1.0, jnp.nan])})
+        assert not bool(out["all_finite"])
+
+    def test_model_norms_empty_pytree(self):
+        out = model_norms({})
+        assert bool(out["all_finite"])
+        assert float(out["l2"]) == 0.0
+        assert float(out["max_abs"]) == 0.0
+
+
+# -- checkpoint crash-safety -------------------------------------------------
+class TestCheckpointCrashSafety:
+    def _roundtrip_setup(self, tmp_path):
+        from fedtorch_tpu.utils.checkpoint import save_checkpoint
+        t = make_trainer()
+        s, c = t.init_state(jax.random.key(0))
+        s, c, _ = t.run_round(s, c)
+        d = str(tmp_path)
+        save_checkpoint(d, s, c, t.cfg, best_prec1=0.5, is_best=False)
+        return t, s, c, d
+
+    def test_valid_checkpoint_resumes(self, tmp_path):
+        from fedtorch_tpu.utils.checkpoint import maybe_resume
+        t, s, c, d = self._roundtrip_setup(tmp_path)
+        s0, c0 = t.init_state(jax.random.key(9))
+        s2, c2, best, resumed = maybe_resume(d, s0, c0, t.cfg)
+        assert resumed and best == 0.5
+        assert int(s2.round) == 1
+
+    def test_truncated_checkpoint_skipped(self, tmp_path):
+        from fedtorch_tpu.utils.checkpoint import maybe_resume
+        t, s, c, d = self._roundtrip_setup(tmp_path)
+        path = os.path.join(d, "checkpoint.ckpt")
+        blob = open(path, "rb").read()
+        with open(path, "wb") as f:
+            f.write(blob[:len(blob) // 2])  # torn write
+        s0, c0 = t.init_state(jax.random.key(9))
+        with pytest.warns(RuntimeWarning, match="corrupt or truncated"):
+            s2, c2, best, resumed = maybe_resume(d, s0, c0, t.cfg)
+        assert not resumed
+
+    def test_bitflipped_checkpoint_skipped(self, tmp_path):
+        from fedtorch_tpu.utils.checkpoint import maybe_resume
+        t, s, c, d = self._roundtrip_setup(tmp_path)
+        path = os.path.join(d, "checkpoint.ckpt")
+        blob = bytearray(open(path, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF  # same length, corrupted content
+        with open(path, "wb") as f:
+            f.write(bytes(blob))
+        s0, c0 = t.init_state(jax.random.key(9))
+        with pytest.warns(RuntimeWarning, match="corrupt or truncated"):
+            _, _, _, resumed = maybe_resume(d, s0, c0, t.cfg)
+        assert not resumed
+
+    def test_indexed_checkpoint_resumes_with_integrity(self, tmp_path):
+        """Per-round keeps carry their own integrity frame: resuming an
+        OLDER indexed checkpoint after newer saves must still verify and
+        succeed (a cross-file record would mismatch the latest meta)."""
+        from fedtorch_tpu.utils.checkpoint import (
+            maybe_resume, save_checkpoint,
+        )
+        t = make_trainer()
+        s, c = t.init_state(jax.random.key(0))
+        d = str(tmp_path)
+        s, c, _ = t.run_round(s, c)
+        save_checkpoint(d, s, c, t.cfg, 0.1, False, save_some_rounds=(1,))
+        s, c, _ = t.run_round(s, c)
+        save_checkpoint(d, s, c, t.cfg, 0.2, False)  # newer latest
+        s0, c0 = t.init_state(jax.random.key(9))
+        s2, _, _, resumed = maybe_resume(d, s0, c0, t.cfg,
+                                         checkpoint_index="1")
+        assert resumed
+        assert int(s2.round) == 1
+
+    def test_missing_meta_still_raises(self, tmp_path):
+        from fedtorch_tpu.utils.checkpoint import maybe_resume
+        t, s, c, d = self._roundtrip_setup(tmp_path)
+        os.remove(os.path.join(d, "checkpoint.json"))
+        s0, c0 = t.init_state(jax.random.key(9))
+        with pytest.raises(FileNotFoundError):
+            maybe_resume(d, s0, c0, t.cfg)
+
+    def test_incompatible_config_still_raises(self, tmp_path):
+        from fedtorch_tpu.utils.checkpoint import maybe_resume
+        t, s, c, d = self._roundtrip_setup(tmp_path)
+        t2 = make_trainer(num_clients=4)
+        s0, c0 = t2.init_state(jax.random.key(9))
+        with pytest.raises(ValueError, match="incompatible"):
+            maybe_resume(d, s0, c0, t2.cfg)
+
+
+# -- multihost init retry ----------------------------------------------------
+class TestInitMultihostRetry:
+    def _cfg(self, **kw):
+        return MeshConfig(coordinator_address="10.0.0.1:1234",
+                          num_processes=2, process_id=0, **kw)
+
+    def test_transient_failure_retries_then_succeeds(self, monkeypatch):
+        from fedtorch_tpu.parallel import mesh
+        calls = {"n": 0}
+
+        def flaky(**kw):
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise ConnectionError("coordinator not up yet")
+
+        monkeypatch.setattr(jax.distributed, "initialize", flaky)
+        delays = []
+        mesh.init_multihost(self._cfg(init_backoff_s=0.25),
+                            _sleep=delays.append)
+        assert calls["n"] == 3
+        assert delays == [0.25, 0.5]  # exponential backoff
+
+    def test_timeout_raises_clear_error(self, monkeypatch):
+        from fedtorch_tpu.parallel import mesh
+
+        def always_down(**kw):
+            raise ConnectionError("nope")
+
+        monkeypatch.setattr(jax.distributed, "initialize", always_down)
+        with pytest.raises(RuntimeError, match="10.0.0.1:1234"):
+            mesh.init_multihost(
+                self._cfg(init_timeout_s=0.5, init_backoff_s=0.3),
+                _sleep=lambda d: None)
+
+    def test_permanent_errors_fail_fast(self, monkeypatch):
+        from fedtorch_tpu.parallel import mesh
+        calls = {"n": 0}
+
+        def malformed(**kw):
+            calls["n"] += 1
+            raise ValueError("bad coordinator address")
+
+        monkeypatch.setattr(jax.distributed, "initialize", malformed)
+        with pytest.raises(ValueError, match="bad coordinator"):
+            mesh.init_multihost(self._cfg(), _sleep=lambda d: None)
+        assert calls["n"] == 1  # no retry burn on a deterministic error
+
+        def already(**kw):
+            # JAX's actual double-init wording (jax/_src/distributed.py)
+            raise RuntimeError(
+                "distributed.initialize should only be called once.")
+
+        monkeypatch.setattr(jax.distributed, "initialize", already)
+        with pytest.raises(RuntimeError, match="only be called once"):
+            mesh.init_multihost(self._cfg(), _sleep=lambda d: None)
+
+    def test_no_coordinator_is_noop(self, monkeypatch):
+        from fedtorch_tpu.parallel import mesh
+
+        def boom(**kw):
+            raise AssertionError("must not be called")
+
+        monkeypatch.setattr(jax.distributed, "initialize", boom)
+        mesh.init_multihost(MeshConfig())  # no address -> no-op
+
+
+# -- config validation -------------------------------------------------------
+class TestFaultConfigValidation:
+    def test_bad_rates_rejected(self):
+        with pytest.raises(ValueError, match="client_drop_rate"):
+            ExperimentConfig(
+                fault=FaultConfig(client_drop_rate=1.5)).finalize()
+        with pytest.raises(ValueError, match="straggler_step_frac"):
+            ExperimentConfig(
+                fault=FaultConfig(straggler_step_frac=0.0)).finalize()
+        with pytest.raises(ValueError, match="guard_mode"):
+            ExperimentConfig(
+                fault=FaultConfig(guard_mode="zap")).finalize()
+
+    def test_cli_flags_map(self):
+        from fedtorch_tpu.cli import args_to_config, build_parser
+        args = build_parser().parse_args([
+            "--federated", "true", "-d", "synthetic",
+            "--fault_client_drop_rate", "0.25",
+            "--fault_straggler_rate", "0.1",
+            "--guard_updates", "true", "--guard_mode", "clip",
+            "--supervisor", "true", "--supervisor_max_retries", "3"])
+        cfg = args_to_config(args)
+        assert cfg.fault.client_drop_rate == 0.25
+        assert cfg.fault.straggler_rate == 0.1
+        assert cfg.fault.guard_updates
+        assert cfg.fault.guard_mode == "clip"
+        assert cfg.fault.supervisor
+        assert cfg.fault.max_retries == 3
+        assert cfg.fault.chaos_enabled
