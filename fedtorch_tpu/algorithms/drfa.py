@@ -30,8 +30,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from fedtorch_tpu.algorithms.base import FedAlgorithm, \
-    num_online_effective
+from fedtorch_tpu.algorithms.base import (FedAlgorithm, num_online_effective)
 from fedtorch_tpu.core.losses import per_sample_loss
 from fedtorch_tpu.core.state import tree_scale, tree_zeros_like
 from fedtorch_tpu.data.batching import sample_batch

@@ -42,8 +42,9 @@ class FedAvg(FedAlgorithm):
             # the client axis is sharded over >1 device: the pallas
             # custom call has no GSPMD partitioning rule, while XLA's
             # quantizer partitions cleanly with the axis.
-            from fedtorch_tpu.ops.pallas import \
-                fused_quantize_dequantize_tree
+            from fedtorch_tpu.ops.pallas import (
+                fused_quantize_dequantize_tree,
+            )
             bits = self.cfg.federated.quantized_bits
             payloads = fused_quantize_dequantize_tree(
                 payloads, bits, leading_batch=True,
@@ -55,8 +56,9 @@ class FedAvg(FedAlgorithm):
             # downlink re-quantization of the summed delta (fedavg.py:54-64)
             # — same bucketed kernel path (the sum is replicated, never
             # sharded, so bucketing is always safe here)
-            from fedtorch_tpu.ops.pallas import \
-                fused_quantize_dequantize_tree
+            from fedtorch_tpu.ops.pallas import (
+                fused_quantize_dequantize_tree,
+            )
             bits = self.cfg.federated.quantized_bits
             payload_sum = fused_quantize_dequantize_tree(
                 payload_sum, bits)

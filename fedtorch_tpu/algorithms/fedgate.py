@@ -64,8 +64,9 @@ class FedGate(FedAlgorithm):
             # client-grid launch per distinct size); XLA fallback when
             # the client axis spans multiple devices (no pallas
             # partitioning rule)
-            from fedtorch_tpu.ops.pallas import \
-                fused_quantize_dequantize_tree
+            from fedtorch_tpu.ops.pallas import (
+                fused_quantize_dequantize_tree,
+            )
             payloads = fused_quantize_dequantize_tree(
                 payloads, self.cfg.federated.quantized_bits,
                 leading_batch=True, sharded=self.mesh_devices > 1)
@@ -76,8 +77,9 @@ class FedGate(FedAlgorithm):
         # server step and the clients' tracking/memory updates
         # (fedgate.py:74-79 broadcasts the re-quantized tensor)
         if self.cfg.federated.quantized:
-            from fedtorch_tpu.ops.pallas import \
-                fused_quantize_dequantize_tree
+            from fedtorch_tpu.ops.pallas import (
+                fused_quantize_dequantize_tree,
+            )
             payload_sum = fused_quantize_dequantize_tree(
                 payload_sum, self.cfg.federated.quantized_bits)
         return payload_sum

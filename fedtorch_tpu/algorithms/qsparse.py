@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from fedtorch_tpu.algorithms.base import FedAlgorithm
 from fedtorch_tpu.core.state import tree_zeros_like
@@ -23,7 +24,11 @@ class Qsparse(FedAlgorithm):
     name = "qsparse"
 
     def setup(self, data) -> None:
-        self._total_samples = float(jnp.sum(data.sizes))
+        # setup-time host math: sizes live on the host at build time,
+        # so summing with numpy avoids a device round-trip entirely
+        # (a jnp.sum here would upload, reduce, and sync back — the
+        # legal-but-wasteful pattern lint FTL001 exists to catch)
+        self._total_samples = float(np.sum(np.asarray(data.sizes)))
 
     def init_client_aux(self, params):
         return {"memory": tree_zeros_like(params)}

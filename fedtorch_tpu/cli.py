@@ -17,7 +17,6 @@ from __future__ import annotations
 import argparse
 import os
 import time
-from typing import Optional
 
 from fedtorch_tpu.config import (
     CheckpointConfig, DataConfig, ExperimentConfig, FaultConfig,
@@ -368,7 +367,6 @@ def run_experiment(cfg: ExperimentConfig,
     enable_compile_cache()
 
     from fedtorch_tpu.algorithms import make_algorithm
-    from fedtorch_tpu.core.schedule import lr_at
     from fedtorch_tpu.data import build_federated_data
     from fedtorch_tpu.models import define_model
     from fedtorch_tpu.parallel import (
@@ -409,8 +407,8 @@ def run_experiment(cfg: ExperimentConfig,
         splits_y = np.asarray(fed_data.train.y).reshape(-1)
         trainer = build_local_sgd(cfg, model, splits_x, splits_y)
         server, clients, history = trainer.fit(rng)
-        res = evaluate(model, server.params, fed_data.test_x,
-                       fed_data.test_y)
+        res = jax.device_get(evaluate(model, server.params,
+                                      fed_data.test_x, fed_data.test_y))
         logger.log_val(len(history), "test", float(res.loss),
                        float(res.top1), float(res.top5))
         return {"test_top1": float(res.top1), "rounds": len(history)}
@@ -423,9 +421,9 @@ def run_experiment(cfg: ExperimentConfig,
         cfg.checkpoint.resume, server, clients, cfg,
         cfg.checkpoint.checkpoint_index)
     if resumed:
-        logger.log(f"resumed from round {int(server.round)}")
+        logger.log("resumed from round "
+                   f"{int(jax.device_get(server.round))}")
 
-    schedule = trainer.schedule
     save_rounds = tuple(
         int(x) for x in cfg.checkpoint.save_some_models.split(","))
     async_ckpt = None
@@ -440,7 +438,7 @@ def run_experiment(cfg: ExperimentConfig,
                                      logger=logger)
         run_round = supervisor.run_round
     results = {}
-    start_round = int(server.round)
+    start_round = int(jax.device_get(server.round))
     loop_raised = False
     try:
         for r in range(start_round, cfg.federated.num_comms):
@@ -450,44 +448,57 @@ def run_experiment(cfg: ExperimentConfig,
                 if cfg.checkpoint.track_model_aggregation else None
             timer.start("round")
             server, clients, metrics = run_round(server, clients)
-            jax.block_until_ready(server.params)
+            if supervisor is None:
+                # the supervisor's health check already blocked
+                jax.block_until_ready(server.params)
             round_time = timer.stop("round")
-            timer.add_comm(num_bytes=float(metrics.comm_bytes))
+            # ONE batched device->host fetch for everything this loop
+            # logs (round_host_scalars) — per-scalar float() here would
+            # serialize a transfer per metric per round (lint FTL001).
+            # A supervised healthy round already fetched the same dict
+            # for its health check: reuse it, don't transfer twice.
+            if supervisor is not None and \
+                    supervisor.last_scalars is not None:
+                sc = supervisor.last_scalars
+            else:
+                sc = trainer.round_host_scalars(clients, metrics)
+            timer.add_comm(num_bytes=sc["comm_bytes"])
 
             if cfg.fault.chaos_enabled or cfg.fault.guard_updates:
-                dropped = float(metrics.dropped_clients)
-                rej = float(metrics.rejected_updates)
-                clip = float(metrics.clipped_updates)
-                strag = float(metrics.straggler_clients)
-                if dropped or rej or clip or strag:
-                    logger.log(f"Round {r}: faults — dropped={dropped:.0f}"
-                               f" stragglers={strag:.0f} rejected={rej:.0f}"
-                               f" clipped={clip:.0f}")
+                if sc["dropped"] or sc["rejected"] or sc["clipped"] \
+                        or sc["stragglers"]:
+                    logger.log(
+                        f"Round {r}: faults — "
+                        f"dropped={sc['dropped']:.0f} "
+                        f"stragglers={sc['stragglers']:.0f} "
+                        f"rejected={sc['rejected']:.0f} "
+                        f"clipped={sc['clipped']:.0f}")
 
             if cfg.checkpoint.check_model_at_sync:
-                norms = model_norms(server.params)
+                norms = jax.device_get(model_norms(server.params))
                 logger.log(f"Round {r}: server model l2="
                            f"{float(norms['l2']):.4f} "
                            f"max|w|={float(norms['max_abs']):.4f}")
             if prev_params is not None:
-                tr = aggregation_tracking(prev_params, server.params)
+                tr = jax.device_get(
+                    aggregation_tracking(prev_params, server.params))
                 logger.log(f"Round {r}: aggregation cosine="
                            f"{float(tr['cosine']):.6f} "
                            f"distance={float(tr['distance']):.6f}")
 
-            n_online = float(jnp.sum(metrics.online_mask))
-            loss = float(jnp.sum(metrics.train_loss) / max(n_online, 1))
-            acc = float(jnp.sum(metrics.train_acc) / max(n_online, 1))
-            epoch = trainer.mean_client_epoch(clients)
-            logger.log_train(r, epoch, loss, acc,
-                             float(lr_at(schedule, epoch)),
-                             comm_bytes=float(metrics.comm_bytes),
+            n_online = max(sc["n_online"], 1.0)
+            epoch = sc["mean_epoch"]
+            logger.log_train(r, epoch, sc["loss_sum"] / n_online,
+                             sc["acc_sum"] / n_online, sc["lr"],
+                             comm_bytes=sc["comm_bytes"],
                              round_time=round_time)
 
             if (r + 1) % cfg.train.eval_freq == 0:
                 timer.start("eval")
-                res = evaluate(model, server.params, fed_data.test_x,
-                               fed_data.test_y)
+                # one transfer for the whole EvalResult pytree
+                res = jax.device_get(evaluate(
+                    model, server.params, fed_data.test_x,
+                    fed_data.test_y))
                 timer.stop("eval")
                 top1 = float(res.top1)
                 is_best = top1 > best_prec1
@@ -560,6 +571,15 @@ def run_experiment(cfg: ExperimentConfig,
 
 
 def main(argv=None):
+    if argv is None:
+        import sys
+        argv = sys.argv[1:]
+    if argv and argv[0] == "lint":
+        # `fedtorch-tpu lint [...]` — the static tracing-hazard
+        # analyzer (docs/static_analysis.md); stdlib-only, never
+        # initializes jax
+        from fedtorch_tpu.lint.cli import main as lint_main
+        return lint_main(argv[1:])
     args = build_parser().parse_args(argv)
     cfg = args_to_config(args)
     return run_experiment(cfg, download=args.download)

@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import dataclasses
 from dataclasses import dataclass, field
-from typing import Optional, Tuple
+from typing import Optional
 
 # Algorithms that keep a second, personalized model per client
 # (ref: parameters.py:257-259).
@@ -184,7 +184,8 @@ class OptimConfig:
 @dataclass(frozen=True)
 class LRConfig:
     """LR schedule compiler inputs (ref: parameters.py:144-166)."""
-    schedule_scheme: Optional[str] = None  # strict|custom_one_cycle|custom_multistep|custom_convex_decay
+    # strict|custom_one_cycle|custom_multistep|custom_convex_decay
+    schedule_scheme: Optional[str] = None
     lr_change_epochs: Optional[str] = None
     lr_fields: Optional[str] = None
     lr_scale_indicators: Optional[str] = None
@@ -356,7 +357,8 @@ class ExperimentConfig:
     def finalize(self) -> "ExperimentConfig":
         """Apply the reference's post-parse derivations & validations
         (parameters.py:245-259)."""
-        data, fed, train, optim = self.data, self.federated, self.train, self.optim
+        data, fed = self.data, self.federated
+        train, optim = self.train, self.optim
 
         if data.growing_batch_size and data.base_batch_size is None:
             data = dataclasses.replace(data, base_batch_size=1)
@@ -374,13 +376,15 @@ class ExperimentConfig:
                     "Federated mode cannot reshuffle data across clients "
                     "mid-training; set reshuffle_per_epoch=False "
                     "(ref: parameters.py:246-247).")
-            # num_epochs = epochs/comm * comms * online rate (parameters.py:248)
+            # num_epochs = epochs/comm * comms * online rate
+            # (parameters.py:248)
             train = dataclasses.replace(
                 train,
                 num_epochs=int(fed.num_epochs_per_comm * fed.num_comms
                                * fed.online_client_rate))
             if fed.algorithm == "afl":
-                # AFL runs exactly one local step per round (parameters.py:249-251).
+                # AFL runs exactly one local step per round
+                # (parameters.py:249-251).
                 fed = dataclasses.replace(fed, sync_type="local_step")
                 train = dataclasses.replace(train, local_step=1)
             if fed.algorithm == "qsparse" and not fed.compressed:
@@ -399,9 +403,11 @@ class ExperimentConfig:
                 train = dataclasses.replace(train, num_epochs=10)
 
         if optim.out_momentum and optim.out_momentum_factor is None:
-            # Default out-momentum 1 - 1/n (ref: components/optimizer.py:24-26).
+            # Default out-momentum 1 - 1/n
+            # (ref: components/optimizer.py:24-26).
             n = max(fed.num_clients, 1)
-            optim = dataclasses.replace(optim, out_momentum_factor=1.0 - 1.0 / n)
+            optim = dataclasses.replace(
+                optim, out_momentum_factor=1.0 - 1.0 / n)
 
         if fed.algorithm not in FEDERATED_ALGORITHMS:
             raise ValueError(f"Unknown federated algorithm {fed.algorithm!r}; "

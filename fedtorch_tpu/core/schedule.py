@@ -16,7 +16,7 @@ and the warmup/multistep field construction (``learning.py:128-182``).
 """
 from __future__ import annotations
 
-from typing import NamedTuple, Optional
+from typing import NamedTuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -54,7 +54,8 @@ def lr_at(sched: LRSchedule, epoch: jnp.ndarray) -> jnp.ndarray:
     # final field (the reference scheduler returns None there; we saturate).
     in_field = (sched.starts <= epoch) & (epoch < sched.ends)
     in_field = in_field | (jnp.arange(sched.starts.shape[0])
-                           == sched.starts.shape[0] - 1) & (epoch >= sched.ends[-1])
+                           == sched.starts.shape[0] - 1) \
+        & (epoch >= sched.ends[-1])
     # FIRST matching field, like the reference's sequential fall_in scan
     # (learning.py:62-70) — fields may overlap (e.g. a warmup interval
     # reaching past the first change epoch) and first-match must win
@@ -62,7 +63,8 @@ def lr_at(sched: LRSchedule, epoch: jnp.ndarray) -> jnp.ndarray:
 
 
 def _parse_fields(lr_fields: str):
-    return [tuple(float(x) for x in f.split(",")) for f in lr_fields.split("/")]
+    return [tuple(float(x) for x in f.split(","))
+            for f in lr_fields.split("/")]
 
 
 def _parse_epochs(lr_change_epochs: str):

@@ -353,8 +353,9 @@ def _read_svmlight_dense(path: str, n_features=None):
     parser is a pure accelerator: input it rejects (non-ascending or
     duplicate indices, unusual separators) falls through to sklearn
     rather than becoming a new failure mode."""
-    from fedtorch_tpu.native.host_pipeline import native_available, \
-        parse_svmlight
+    from fedtorch_tpu.native.host_pipeline import (
+        native_available, parse_svmlight,
+    )
     if native_available():
         try:
             parsed = parse_svmlight(_read_file_bytes(path),

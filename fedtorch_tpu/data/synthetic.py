@@ -18,7 +18,7 @@ returned as plain arrays for `stack_partitions`.
 """
 from __future__ import annotations
 
-from typing import List, NamedTuple, Tuple
+from typing import List, NamedTuple
 
 import numpy as np
 

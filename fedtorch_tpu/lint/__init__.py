@@ -1,0 +1,21 @@
+"""fedtorch_tpu.lint — TPU tracing-hazard static analysis.
+
+An AST pass purpose-built for this codebase (rationale and rule
+catalog: docs/static_analysis.md).  The JAX port's worst failure class
+is silent: host syncs in round loops, numpy leaking into traced code,
+PRNG key reuse, missing buffer donation, Python branches on traced
+values — none crash, all destroy TPU throughput or determinism.  The
+static rules here approximate what the runtime recompilation sentinel
+(``fedtorch_tpu.utils.tracing.RecompilationSentinel``) measures
+dynamically; the two gates ship together (scripts/lint_suite.py).
+
+Stdlib-only: importing this package must never pull in jax, so the
+gate runs in any CI lane.
+"""
+from fedtorch_tpu.lint.analyzer import (  # noqa: F401
+    ModuleAnalysis, analyze_paths, analyze_source,
+)
+from fedtorch_tpu.lint.findings import (  # noqa: F401
+    Finding, diff_against_baseline, load_baseline, save_baseline,
+)
+from fedtorch_tpu.lint.rules import RULES  # noqa: F401

@@ -1,0 +1,5 @@
+import sys
+
+from fedtorch_tpu.lint.cli import main
+
+sys.exit(main())

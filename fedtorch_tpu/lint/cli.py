@@ -1,0 +1,95 @@
+"""``python -m fedtorch_tpu.lint`` / ``fedtorch-tpu lint`` entry point.
+
+Runs the tracing-hazard analyzer over the default targets (the package
+plus ``scripts/`` and ``bench.py``), diffs against the checked-in
+baseline, and exits non-zero only on NEW findings — the regression
+gate ``scripts/lint_suite.py`` and ``tests/test_lint_suite.py`` wrap.
+
+    python -m fedtorch_tpu.lint                 # gate (default paths)
+    python -m fedtorch_tpu.lint --all           # ignore the baseline
+    python -m fedtorch_tpu.lint --write-baseline  # accept current state
+    python -m fedtorch_tpu.lint --explain       # rule catalog
+    python -m fedtorch_tpu.lint path/to/file.py # specific targets
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from fedtorch_tpu.lint.analyzer import analyze_paths
+from fedtorch_tpu.lint.findings import (
+    diff_against_baseline, load_baseline, save_baseline,
+)
+from fedtorch_tpu.lint.rules import explain
+
+DEFAULT_TARGETS = ("fedtorch_tpu", "scripts", "bench.py", "run_tpu.py")
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(
+    __file__)), "baseline.json")
+
+
+def repo_root() -> str:
+    """The directory the package sits in (works from a checkout)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="fedtorch-tpu lint",
+        description="TPU tracing-hazard static analysis "
+                    "(docs/static_analysis.md)")
+    p.add_argument("targets", nargs="*", default=None,
+                   help="files/dirs relative to the repo root "
+                        f"(default: {' '.join(DEFAULT_TARGETS)})")
+    p.add_argument("--root", default=None,
+                   help="repo root (default: auto-detected)")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help="baseline JSON path")
+    p.add_argument("--all", action="store_true",
+                   help="report every finding, ignoring the baseline")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="accept the current findings as the baseline")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--explain", action="store_true",
+                   help="print the rule catalog and exit")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.explain:
+        print(explain())
+        return 0
+    root = args.root or repo_root()
+    targets = args.targets or list(DEFAULT_TARGETS)
+    findings = analyze_paths(root, targets)
+
+    if args.write_baseline:
+        save_baseline(args.baseline, findings)
+        print(f"wrote {len(findings)} finding(s) to {args.baseline}")
+        return 0
+
+    if args.all:
+        new, matched = findings, 0
+    else:
+        baseline = load_baseline(args.baseline)
+        new, matched = diff_against_baseline(findings, baseline)
+
+    if args.format == "json":
+        print(json.dumps({
+            "total": len(findings), "baselined": matched,
+            "new": [f.__dict__ for f in new]}, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        label = "finding(s)" if args.all else "NEW finding(s)"
+        print(f"fedtorch_tpu.lint: {len(new)} {label} "
+              f"({len(findings)} total, {matched} baselined)")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,76 @@
+"""Rule registry: ids, rationale, and fix hints.
+
+The detection logic lives in ``analyzer.py``; this module is the
+single place a rule's id, one-line description, and default fix hint
+are defined, so the CLI ``--explain`` output, the docs, and the
+analyzer messages cannot drift apart.
+
+Why each rule exists on TPU (long form: docs/static_analysis.md):
+
+* FTL001 — ``float()``/``int()``/``bool()``/``.item()``/``np.asarray``
+  on a device value blocks the host on the device stream.  Inside
+  traced code it either fails at trace time or silently pins a
+  host round-trip into every step; on host round loops it serializes
+  dispatch against execution and caps throughput.
+* FTL002 — ``numpy`` ops inside a jitted function fall out of the
+  traced program: they run once at trace time on tracer metadata (or
+  crash), producing silently-constant results.
+* FTL003 — reusing a PRNG key without ``split``/``fold_in`` makes two
+  "random" draws identical, quietly correlating client sampling,
+  dropout, and chaos schedules.
+* FTL004 — a jitted function that rebuilds and returns its large array
+  arguments without ``donate_argnums`` forces XLA to keep both the old
+  and new buffers live: 2x HBM for the model/optimizer state.
+* FTL005 — Python ``if``/``while`` on a traced value either raises a
+  ``TracerBoolConversionError`` or — when the operand is concretized
+  via a scalar coercion — bakes one branch into the compiled program
+  and retraces when the value flips shape/dtype paths.
+"""
+from __future__ import annotations
+
+from typing import Dict, NamedTuple
+
+
+class Rule(NamedTuple):
+    rule_id: str
+    title: str
+    hint: str
+
+
+RULES: Dict[str, Rule] = {r.rule_id: r for r in [
+    Rule("FTL001",
+         "host sync on a device value "
+         "(float()/int()/bool()/.item()/np.asarray)",
+         "batch scalars into one jax.device_get(pytree) at a round "
+         "boundary, or keep the value on device"),
+    Rule("FTL002",
+         "numpy op on a traced value inside jitted code",
+         "use the jnp equivalent inside jit; numpy is legal only on "
+         "setup-time host constants"),
+    Rule("FTL003",
+         "PRNG key consumed more than once without split/fold_in",
+         "derive a fresh key per consumer: k1, k2 = jax.random.split"
+         "(key) or key = jax.random.fold_in(key, step)"),
+    Rule("FTL004",
+         "jitted function returns arrays rebuilt from its inputs "
+         "without donate_argnums",
+         "pass donate_argnums=... to jax.jit so XLA reuses the input "
+         "buffers (only when callers don't reuse the inputs)"),
+    Rule("FTL005",
+         "Python branching on a traced value",
+         "use jnp.where / lax.cond / lax.select, or hoist the decision "
+         "to static config"),
+]}
+
+
+def hint_for(rule_id: str) -> str:
+    return RULES[rule_id].hint
+
+
+def explain() -> str:
+    lines = ["fedtorch_tpu.lint rules (details: docs/static_analysis.md)",
+             ""]
+    for r in RULES.values():
+        lines.append(f"  {r.rule_id}  {r.title}")
+        lines.append(f"          fix: {r.hint}")
+    return "\n".join(lines)

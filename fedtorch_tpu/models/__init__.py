@@ -17,11 +17,13 @@ from fedtorch_tpu.models.common import (
     num_classes_of,
 )
 from fedtorch_tpu.models.densenet import DenseNet, build_densenet
-from fedtorch_tpu.models.linear import LeastSquare, LinearMAFL, \
-    LogisticRegression
+from fedtorch_tpu.models.linear import (
+    LeastSquare, LinearMAFL, LogisticRegression,
+)
 from fedtorch_tpu.models.mlp import MLP
-from fedtorch_tpu.models.resnet import ResNetCifar, ResNetImageNet, \
-    build_resnet
+from fedtorch_tpu.models.resnet import (
+    ResNetCifar, ResNetImageNet, build_resnet,
+)
 from fedtorch_tpu.models.rnn import CharGRU
 from fedtorch_tpu.models.wideresnet import WideResNet, build_wideresnet
 
@@ -143,11 +145,13 @@ def define_model(cfg: ExperimentConfig, batch_size: int = 2) -> ModelDef:
     if arch == "logistic_regression":
         return ModelDef(arch, LogisticRegression(
             dataset=dataset, dtype=cfg.mesh.compute_dtype),
-                        _sample_flat(dataset, batch_size, cfg.data.synthetic_dim))
+                        _sample_flat(dataset, batch_size,
+                                     cfg.data.synthetic_dim))
     if arch == "robust_logistic_regression":
         return ModelDef(arch, LogisticRegression(
             dataset=dataset, robust=True, dtype=cfg.mesh.compute_dtype),
-                        _sample_flat(dataset, batch_size, cfg.data.synthetic_dim),
+                        _sample_flat(dataset, batch_size,
+                                     cfg.data.synthetic_dim),
                         has_noise_param=True)
     if arch == "least_square":
         return ModelDef(arch, LeastSquare(dataset=dataset,
@@ -165,13 +169,17 @@ def define_model(cfg: ExperimentConfig, batch_size: int = 2) -> ModelDef:
         module = MLP(dataset=dataset, num_layers=m.mlp_num_layers,
                      hidden_size=m.mlp_hidden_size, drop_rate=m.drop_rate,
                      norm=m.norm, dtype=cfg.mesh.compute_dtype)
-        return ModelDef(arch, module, _sample_flat(dataset, batch_size, cfg.data.synthetic_dim))
+        return ModelDef(arch, module,
+                        _sample_flat(dataset, batch_size,
+                                     cfg.data.synthetic_dim))
     if arch == "robust_mlp":
         module = MLP(dataset=dataset, num_layers=m.mlp_num_layers,
                      hidden_size=m.mlp_hidden_size, drop_rate=m.drop_rate,
                      norm=m.norm, robust=True,
                      dtype=cfg.mesh.compute_dtype)
-        return ModelDef(arch, module, _sample_flat(dataset, batch_size, cfg.data.synthetic_dim),
+        return ModelDef(arch, module,
+                        _sample_flat(dataset, batch_size,
+                                     cfg.data.synthetic_dim),
                         has_noise_param=True)
     if arch == "cnn":
         return ModelDef(arch,

@@ -10,8 +10,9 @@ from __future__ import annotations
 import flax.linen as nn
 import jax.numpy as jnp
 
-from fedtorch_tpu.models.common import conv_of, make_norm, \
-    norm_f32, num_classes_of
+from fedtorch_tpu.models.common import (
+    conv_of, make_norm, norm_f32, num_classes_of,
+)
 
 
 class _DenseLayer(nn.Module):

@@ -19,8 +19,9 @@ from typing import Type
 import flax.linen as nn
 import jax.numpy as jnp
 
-from fedtorch_tpu.models.common import conv_of, \
-    norm_f32 as _norm32, num_classes_of
+from fedtorch_tpu.models.common import (
+    conv_of, norm_f32 as _norm32, num_classes_of,
+)
 
 
 class BasicBlock(nn.Module):

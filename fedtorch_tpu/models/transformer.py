@@ -31,7 +31,6 @@ Both modes sow the Switch load-balancing auxiliary loss into the
 from __future__ import annotations
 
 import math
-from typing import Optional
 
 import flax.linen as nn
 import jax
@@ -60,8 +59,9 @@ class _SelfAttention(nn.Module):
             # fused online-softmax kernel: O(block^2) score memory, one
             # HBM write (ops/pallas/flash_attention.py; exact, with a
             # dense fallback off-TPU)
-            from fedtorch_tpu.ops.pallas.flash_attention import \
-                flash_attention
+            from fedtorch_tpu.ops.pallas.flash_attention import (
+                flash_attention,
+            )
             out = flash_attention(q, k, v, causal=True).astype(dt)
         else:
             scale = 1.0 / math.sqrt(head_dim)
@@ -343,8 +343,9 @@ def long_context_apply(module: TransformerLM, params, tokens, mesh,
     attends through the fused flash kernel: per rotating K/V block for
     the ring (the Ring Attention paper's blockwise-kernel form), or for
     the local full-sequence head slice under ulysses."""
-    from fedtorch_tpu.parallel.sequence import ring_attention, \
-        ulysses_attention
+    from fedtorch_tpu.parallel.sequence import (
+        ring_attention, ulysses_attention,
+    )
 
     if strategy not in ("ring", "ulysses"):
         raise ValueError(f"unknown sequence-parallel strategy {strategy!r}")

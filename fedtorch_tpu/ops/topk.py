@@ -14,7 +14,7 @@ fedgate.py:74-79) is implemented by the callers in
 """
 from __future__ import annotations
 
-from typing import NamedTuple, Tuple
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp

@@ -17,6 +17,7 @@ import numpy as np
 
 from fedtorch_tpu.core.losses import make_criterion, topk_accuracy
 from fedtorch_tpu.models.common import ModelDef
+from fedtorch_tpu.utils.tracing import instrument_trace
 
 
 class EvalResult(NamedTuple):
@@ -86,7 +87,10 @@ def _ascent_on_batches(model: ModelDef, params, bx, by, bm,
             params, _ = jax.lax.scan(body, params, (bx, by, bm))
             return params
 
-        _ASCENT_CACHE[key] = jax.jit(run)
+        # caller reuses params after the ascent, so donation is unsafe
+        # lint: disable=FTL004 — caller reuses the params buffers
+        _ASCENT_CACHE[key] = jax.jit(
+            instrument_trace("evaluate.ascent", run))
     return _ASCENT_CACHE[key](params, bx, by, bm)
 
 
@@ -158,7 +162,10 @@ def evaluate(model: ModelDef, params, x: np.ndarray, y: np.ndarray,
             return EvalResult(jnp.sum(losses) / total,
                               jnp.sum(t1s) / total, jnp.sum(t5s) / total)
 
-        _EVAL_CACHE[key] = jax.jit(run)
+        # params is the live server model, reused every round
+        # lint: disable=FTL004 — live server params, donation unsafe
+        _EVAL_CACHE[key] = jax.jit(
+            instrument_trace("evaluate.run", run))
     return _EVAL_CACHE[key](params, bx, by, bm)
 
 
@@ -178,6 +185,7 @@ def evaluate_clients(model: ModelDef, client_params, data,
     if apply_fn is None:
         apply_fn = forward_fn(model)
 
+    # lint: disable=FTL004 — client_params stay live in the trainer
     @jax.jit
     def run(client_params, data):
         def one(params, x, y, size):
@@ -200,18 +208,23 @@ def evaluate_clients(model: ModelDef, client_params, data,
     # size-0 clients are mesh-padding (pad_client_axis) — exclude them
     # from the cross-client summaries. Masked on-device reductions: the
     # per-client arrays may span non-addressable devices on a multi-host
-    # mesh, where only replicated scalars can be fetched.
+    # mesh, where only replicated scalars can be fetched. The five
+    # summary scalars come back in ONE batched device_get instead of
+    # five blocking per-metric transfers (this call sits in the
+    # per-round eval path — fedtorch_tpu.lint FTL001).
     valid = jnp.asarray(data.sizes) > 0
     n = jnp.maximum(jnp.sum(valid), 1).astype(jnp.float32)
     acc_mean = jnp.sum(jnp.where(valid, accs, 0.0)) / n
     summary = {
-        "loss_mean": float(jnp.sum(jnp.where(valid, losses, 0.0)) / n),
-        "acc_mean": float(acc_mean),
-        "acc_worst": float(jnp.min(jnp.where(valid, accs, jnp.inf))),
-        "acc_best": float(jnp.max(jnp.where(valid, accs, -jnp.inf))),
-        "acc_var": float(jnp.sum(
-            jnp.where(valid, jnp.square(accs - acc_mean), 0.0)) / n),
+        "loss_mean": jnp.sum(jnp.where(valid, losses, 0.0)) / n,
+        "acc_mean": acc_mean,
+        "acc_worst": jnp.min(jnp.where(valid, accs, jnp.inf)),
+        "acc_best": jnp.max(jnp.where(valid, accs, -jnp.inf)),
+        "acc_var": jnp.sum(
+            jnp.where(valid, jnp.square(accs - acc_mean), 0.0)) / n,
     }
+    summary = {k: float(v) for k, v in
+               jax.device_get(summary).items()}
     return losses, accs, summary
 
 
@@ -257,7 +270,9 @@ def evaluate_per_class(model: ModelDef, params, x: np.ndarray,
                 (bx, by, bm))
             return c_sum / jnp.maximum(t_sum, 1.0), t_sum
 
-        _PER_CLASS_CACHE[key] = jax.jit(run)
+        # lint: disable=FTL004 — live server params, donation unsafe
+        _PER_CLASS_CACHE[key] = jax.jit(
+            instrument_trace("evaluate.per_class", run))
     return _PER_CLASS_CACHE[key](params, bx, by, bm)
 
 

@@ -45,8 +45,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from fedtorch_tpu.algorithms.base import FedAlgorithm, \
-    num_online_effective
+from fedtorch_tpu.algorithms.base import (FedAlgorithm, num_online_effective)
 from fedtorch_tpu.config import ExperimentConfig
 from fedtorch_tpu.core import optim
 from fedtorch_tpu.core.losses import make_criterion, per_sample_loss
@@ -55,15 +54,19 @@ from fedtorch_tpu.core.state import (
     ClientState, RoundMetrics, ServerState, tree_bytes, tree_sub,
     tree_where, tree_zeros_like,
 )
-from fedtorch_tpu.data.batching import ClientData, epoch_permutation, \
-    pad_client_axis, take_batch
+from fedtorch_tpu.data.batching import (
+    ClientData, epoch_permutation, pad_client_axis, take_batch,
+)
 from fedtorch_tpu.models.common import ModelDef
 from fedtorch_tpu.ops.augment import augment_image_batch
-from fedtorch_tpu.parallel.mesh import make_mesh, padded_client_count, \
-    replicate, shard_clients
-from fedtorch_tpu.robustness.chaos import draw_chaos_plan, no_chaos_plan, \
-    poison_tree
+from fedtorch_tpu.parallel.mesh import (
+    make_mesh, padded_client_count, replicate, shard_clients,
+)
+from fedtorch_tpu.robustness.chaos import (
+    draw_chaos_plan, no_chaos_plan, poison_tree,
+)
 from fedtorch_tpu.robustness.guards import screen_payloads
+from fedtorch_tpu.utils.tracing import instrument_trace
 
 
 def participation_indices(rng: jax.Array, num_clients: int, k: int,
@@ -167,7 +170,14 @@ class FederatedTrainer:
         self.val_data = shard_clients(
             pad_client_axis(val_data, self.padded_clients), self.mesh) \
             if val_data is not None else None
-        self._round_jit = jax.jit(self.round_fn, donate_argnums=(0, 1))
+        # trace-event instrumentation (utils.tracing): the sentinel
+        # test asserts this program traces exactly once per trainer —
+        # "static config => unchanged traced program" is the contract
+        # both the chaos layer and the bench path rely on
+        self.trace_name = f"federated.round[{algorithm.name}]"
+        self._round_jit = jax.jit(
+            instrument_trace(self.trace_name, self.round_fn),
+            donate_argnums=(0, 1))
         self._rounds_jit: dict = {}  # num_rounds -> jitted scan driver
 
     # -- state ----------------------------------------------------------
@@ -544,11 +554,44 @@ class FederatedTrainer:
             clipped_updates=jnp.asarray(clipped, jnp.float32))
         return new_server, new_clients, metrics
 
+    def _mean_epoch_dev(self, clients) -> jnp.ndarray:
+        """Device-side mean training epoch over the REAL clients — the
+        one sanctioned reduction over client state: the padded tail
+        (pad_client_axis) never advances, so naive means are biased by
+        real/padded. Single definition shared by every consumer
+        (mean_client_epoch, round_host_scalars, the LocalSGD loop)."""
+        return jnp.mean(clients.epoch[:self.num_clients])
+
     def mean_client_epoch(self, clients) -> float:
-        """Mean training epoch over the REAL clients — the one sanctioned
-        reduction over client state: the padded tail (pad_client_axis)
-        never advances, so naive means are biased by real/padded."""
-        return float(jnp.mean(clients.epoch[:self.num_clients]))
+        return float(jax.device_get(self._mean_epoch_dev(clients)))
+
+    def round_scalars_dev(self, clients, metrics) -> dict:
+        """DEVICE-side dict of everything the host round loop logs —
+        no transfer here, so callers (the CLI loop, the round
+        supervisor) can extend it and pay ONE ``device_get`` total."""
+        mean_epoch = self._mean_epoch_dev(clients)
+        return {
+            "mean_epoch": mean_epoch,
+            # the logged LR is a jnp computation over the schedule
+            # arrays — evaluate it on device and ride the same fetch
+            "lr": lr_at(self.schedule, mean_epoch),
+            "n_online": jnp.sum(metrics.online_mask),
+            "loss_sum": jnp.sum(metrics.train_loss),
+            "acc_sum": jnp.sum(metrics.train_acc),
+            "comm_bytes": metrics.comm_bytes,
+            "dropped": metrics.dropped_clients,
+            "stragglers": metrics.straggler_clients,
+            "rejected": metrics.rejected_updates,
+            "clipped": metrics.clipped_updates,
+        }
+
+    def round_host_scalars(self, clients, metrics) -> dict:
+        """Everything the host round loop logs, fetched in ONE batched
+        ``device_get`` — the per-round alternative to a pile of
+        ``float(...)`` calls that each block on a separate transfer
+        (fedtorch_tpu.lint FTL001; docs/static_analysis.md)."""
+        return {k: float(v) for k, v in jax.device_get(
+            self.round_scalars_dev(clients, metrics)).items()}
 
     # -- host-side round loop ---------------------------------------------
     def run_round(self, server, clients):
@@ -576,7 +619,10 @@ class FederatedTrainer:
                 return s, c, ms
 
             self._rounds_jit[num_rounds] = jax.jit(
-                rounds_fn, donate_argnums=(0, 1))
+                instrument_trace(
+                    f"federated.rounds[{self.algorithm.name}]"
+                    f"x{num_rounds}", rounds_fn),
+                donate_argnums=(0, 1))
         return self._rounds_jit[num_rounds](server, clients, self.data,
                                             self.val_data)
 

@@ -18,7 +18,6 @@ Differences from the federated engine it reuses:
 """
 from __future__ import annotations
 
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -27,8 +26,9 @@ import numpy as np
 from fedtorch_tpu.algorithms.fedavg import FedAvg
 from fedtorch_tpu.config import ExperimentConfig
 from fedtorch_tpu.core.sync import local_steps_from_config
-from fedtorch_tpu.data.batching import ClientData, pad_client_axis, \
-    stack_partitions
+from fedtorch_tpu.data.batching import (
+    ClientData, pad_client_axis, stack_partitions,
+)
 from fedtorch_tpu.data.partition import iid_partition
 from fedtorch_tpu.models.common import ModelDef
 from fedtorch_tpu.parallel.federated import FederatedTrainer
@@ -133,8 +133,12 @@ class LocalSGDTrainer(FederatedTrainer):
         history = []
         last_epoch_int = 0
         while True:
-            epoch = self.mean_client_epoch(clients)
-            it = int(jnp.max(clients.local_index))
+            # one batched fetch of the two loop-control scalars per
+            # iteration instead of two blocking transfers (lint FTL001)
+            prog = jax.device_get({
+                "epoch": self._mean_epoch_dev(clients),
+                "it": jnp.max(clients.local_index)})
+            epoch, it = float(prog["epoch"]), int(prog["it"])
             if cfg.train.stop_criteria == "iteration" \
                     and cfg.train.num_iterations is not None:
                 if it >= cfg.train.num_iterations:

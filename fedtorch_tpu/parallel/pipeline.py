@@ -142,4 +142,5 @@ def _pipelined_fwd(module: TransformerLM, mesh: Mesh, axis_name: str,
         x = out.reshape(*tokens.shape, -1)
         return module.apply({"params": params}, x, method="head_apply")
 
+    # lint: disable=FTL004 — params/tokens are reused by the caller
     return jax.jit(fwd)

@@ -47,6 +47,7 @@ def _block_attend(q, k, v, m_prev, l_prev, o_prev, q_offset, k_offset,
     o: [B, Sq, H, D]. Offsets are absolute sequence positions of the
     blocks for causal masking."""
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale  # [B,H,Sq,Sk]
+    # lint: disable=FTL005 — causal is a static config flag
     if causal:
         q_pos = q_offset + jnp.arange(q.shape[1])
         k_pos = k_offset + jnp.arange(k.shape[1])
@@ -97,8 +98,9 @@ def _ring_flash_local(q, k, v, *, axis_name: str, causal: bool,
     before the local Q block attends densely, the diagonal block runs
     the causal kernel, and blocks strictly after contribute an -inf-lse
     piece without computing anything."""
-    from fedtorch_tpu.ops.pallas.flash_attention import \
-        flash_attention_with_lse
+    from fedtorch_tpu.ops.pallas.flash_attention import (
+        flash_attention_with_lse,
+    )
 
     num_shards = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
@@ -247,8 +249,7 @@ def _ulysses_local(q, k, v, *, axis_name: str, causal: bool,
     v = jax.lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1,
                            tiled=True)
     if block_impl == "flash":
-        from fedtorch_tpu.ops.pallas.flash_attention import \
-            flash_attention
+        from fedtorch_tpu.ops.pallas.flash_attention import (flash_attention)
         o = flash_attention(q, k, v, causal=causal, scale=scale)
     else:
         o = reference_attention(q, k, v, causal=causal, scale=scale)

@@ -35,6 +35,7 @@ round cannot fix a structurally broken program.
 from __future__ import annotations
 
 import dataclasses
+import math
 import time
 from typing import Callable, Optional
 
@@ -99,31 +100,53 @@ class RoundSupervisor:
         self.logger = logger
         self.sleep_fn = sleep_fn
         self.stats = SupervisorStats()
+        # host scalars of the round that just passed the health check,
+        # for the driver loop to log without a second device fetch;
+        # None after a skipped round (there is nothing real to log)
+        self.last_scalars = None
 
     # -- health ---------------------------------------------------------
-    def _mean_online_loss(self, metrics: RoundMetrics) -> float:
-        n = float(jnp.sum(metrics.online_mask))
-        return float(jnp.sum(metrics.train_loss)) / max(n, 1.0)
+    def _round_health(self, server, clients, metrics: RoundMetrics) \
+            -> dict:
+        """ONE batched device->host fetch of everything the per-round
+        health checks read — the trainer's full log-scalar dict plus
+        the finite flag and round index — instead of a blocking
+        transfer per scalar (lint FTL001). The fetched scalars are
+        kept on ``self.last_scalars`` so the host round loop reuses
+        them instead of paying a second transfer."""
+        dev = self.trainer.round_scalars_dev(clients, metrics)
+        dev["finite"] = model_norms(server.params)["all_finite"]
+        dev["round"] = server.round
+        h = {k: float(v) for k, v in jax.device_get(dev).items()}
+        self.last_scalars = h
+        n = h["n_online"]
+        return {"finite": bool(h["finite"]), "n": n,
+                "loss": h["loss_sum"] / max(n, 1.0),
+                "round": int(h["round"])}
 
-    def _healthy(self, server, metrics) -> bool:
-        if not bool(model_norms(server.params)["all_finite"]):
+    def _healthy(self, health: dict) -> bool:
+        if not health["finite"]:
             return False
         f = self.fault.loss_blowup_factor
-        if f > 0.0:
-            loss = self._mean_online_loss(metrics)
-            if not jnp.isfinite(loss):
+        if f > 0.0 and health["n"] > 0:
+            loss = health["loss"]
+            if not math.isfinite(loss):
                 return False
             ema = self.stats.loss_ema
             if ema is not None and loss > f * ema:
                 return False
         return True
 
-    def _note_healthy(self, server, metrics) -> None:
+    def _note_healthy(self, health: dict) -> None:
         st = self.stats
         st.healthy_rounds += 1
-        st.last_good_round = int(server.round) - 1
-        loss = self._mean_online_loss(metrics)
-        if jnp.isfinite(loss):
+        st.last_good_round = health["round"] - 1
+        loss = health["loss"]
+        # a zero-participation round (all online clients crashed)
+        # carries no loss observation: feeding its 0.0 into the EMA
+        # would decay it toward 0 and wedge the blow-up check into
+        # rejecting every genuine round afterwards
+        if health["n"] > 0 and math.isfinite(loss):
             st.loss_ema = loss if st.loss_ema is None else (
                 (1 - self.EMA_ALPHA) * st.loss_ema + self.EMA_ALPHA * loss)
 
@@ -174,7 +197,7 @@ class RoundSupervisor:
         flt = self.fault
         self.stats.rounds += 1
         snapshot = (tree_device_copy(server), tree_device_copy(clients))
-        round_idx = int(server.round)
+        round_idx = int(jax.device_get(server.round))
         last_exc: Optional[Exception] = None
         produced_state = False
 
@@ -184,9 +207,11 @@ class RoundSupervisor:
                     server, clients)
                 jax.block_until_ready(out_s.params)
                 produced_state = True
-                if self._healthy(out_s, metrics):
-                    self._note_healthy(out_s, metrics)
+                health = self._round_health(out_s, out_c, metrics)
+                if self._healthy(health):
+                    self._note_healthy(health)
                     return out_s, out_c, metrics
+                self.last_scalars = None  # unhealthy: don't log these
                 why = "non-finite server params or loss blow-up"
             except Exception as e:  # XLA runtime / dispatch failures
                 last_exc = e

@@ -10,5 +10,10 @@ from fedtorch_tpu.utils.meters import (  # noqa: F401
     AverageMeter, PhaseTimer, define_local_training_tracker,
     define_val_tracker,
 )
-from fedtorch_tpu.utils.compile_cache import enable_compile_cache  # noqa: F401,E501
+from fedtorch_tpu.utils.compile_cache import (  # noqa: F401
+    enable_compile_cache, jit_cache_size,
+)
 from fedtorch_tpu.utils.platform import honor_platform_env  # noqa: F401
+from fedtorch_tpu.utils.tracing import (  # noqa: F401
+    RecompilationSentinel, instrument_trace, trace_counts,
+)

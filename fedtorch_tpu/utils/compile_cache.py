@@ -41,3 +41,17 @@ def enable_compile_cache(cache_dir: str | None = None) -> str | None:
         return path
     except Exception:  # old jax without the flags: cache is best-effort
         return None
+
+
+def jit_cache_size(jitted) -> int | None:
+    """Number of compiled executables held by a ``jax.jit``-wrapped
+    callable — the compilation-side twin of the trace-event counter in
+    ``utils.tracing``: trace events count Python re-entries, this
+    counts distinct (shape, dtype, static-arg) specializations that
+    survived to an executable.  A hot path that is healthy shows
+    exactly 1 of each.  Returns None when jax's private probe is
+    unavailable (the sentinel then relies on trace counts alone)."""
+    try:
+        return int(jitted._cache_size())
+    except Exception:
+        return None

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 
 
+# lint: disable=FTL004 — callers keep using the params they pass in
 @jax.jit
 def model_norms(params) -> Dict[str, jnp.ndarray]:
     """Global l2 norm + per-leaf max abs (check_training.py:22-37) +
@@ -31,6 +32,7 @@ def model_norms(params) -> Dict[str, jnp.ndarray]:
     round-trips). An empty pytree is trivially finite with zero norm
     (a structural no-params edge case, not an error)."""
     leaves = jax.tree.leaves(params)
+    # lint: disable=FTL005 — leaves is a Python list; emptiness is static
     if not leaves:
         return {"l2": jnp.zeros(()), "max_abs": jnp.zeros(()),
                 "all_finite": jnp.asarray(True)}
@@ -41,6 +43,7 @@ def model_norms(params) -> Dict[str, jnp.ndarray]:
     return {"l2": jnp.sqrt(sq), "max_abs": mx, "all_finite": finite}
 
 
+# lint: disable=FTL004 — callers keep using both param trees
 @jax.jit
 def aggregation_tracking(old_params, new_params) -> Dict[str, jnp.ndarray]:
     """Cosine similarity and l2 distance between the model before and

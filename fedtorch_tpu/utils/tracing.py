@@ -1,16 +1,105 @@
-"""Profiling / tracing.
+"""Profiling / tracing — and the recompilation sentinel.
 
 The reference only hand-times phases (SURVEY.md §5.1); the TPU build adds
 real profiler traces: ``jax.profiler`` emits a TensorBoard-compatible
 trace of the XLA execution (HLO ops, fusion, collective time on ICI),
 which is the per-phase attribution the hand timers cannot see inside one
 compiled round.
+
+The **recompilation sentinel** is the runtime half of the tracing-hazard
+gate (static half: ``fedtorch_tpu.lint``, docs/static_analysis.md).
+Hot callables are registered with :func:`instrument_trace` before they
+are handed to ``jax.jit``; tracing executes the wrapped Python body, so
+each body execution == one trace event, while steady-state compiled
+calls never re-enter Python.  :class:`RecompilationSentinel` scopes the
+counting: the tier-1 test asserts the FedAvg/SCAFFOLD round programs
+trace exactly once across many rounds and fault schedules — the
+"static config => unchanged traced program" contract PR 1's chaos
+machinery depends on.
 """
 from __future__ import annotations
 
 import contextlib
+import functools
+from collections import Counter
+from typing import Callable, Dict, List, Optional
 
 import jax
+
+# process-lifetime trace counts per instrumented callable name; the
+# sentinel snapshots deltas of this via its own scoped counter
+_TRACE_COUNTS: Counter = Counter()
+_ACTIVE_SENTINELS: List["RecompilationSentinel"] = []
+
+
+def instrument_trace(name: str, fn: Optional[Callable] = None):
+    """Wrap ``fn`` so each execution of its PYTHON body is counted as a
+    trace event under ``name``.  Apply to the function handed to
+    ``jax.jit`` (inside the jit boundary the body only runs while
+    tracing); also usable as ``@instrument_trace("name")``.
+
+    Counts are trace events, not compiles: with the persistent
+    compilation cache warm, a retrace still re-executes the body (and
+    still costs trace+lowering time) even though XLA compilation is
+    skipped — which is exactly what the sentinel must see.
+    """
+    def deco(f: Callable) -> Callable:
+        @functools.wraps(f)
+        def wrapped(*args, **kwargs):
+            record_trace_event(name)
+            return f(*args, **kwargs)
+        wrapped.__fedtorch_trace_name__ = name
+        return wrapped
+    return deco if fn is None else deco(fn)
+
+
+def record_trace_event(name: str) -> None:
+    _TRACE_COUNTS[name] += 1
+    for s in _ACTIVE_SENTINELS:
+        s.counts[name] += 1
+
+
+def trace_counts() -> Dict[str, int]:
+    """Process-lifetime trace counts (name -> events)."""
+    return dict(_TRACE_COUNTS)
+
+
+class RecompilationSentinel:
+    """Scoped trace-event counter.
+
+    ::
+
+        with RecompilationSentinel() as s:
+            for _ in range(rounds):
+                server, clients, m = trainer.run_round(server, clients)
+        s.assert_traces("federated.round[fedavg]", expected=1)
+
+    Any count above ``expected`` means something retraced the round
+    program mid-run — a shape/dtype/static-arg change the static
+    analyzer (fedtorch_tpu.lint) exists to catch before it ships.
+    """
+
+    def __init__(self):
+        self.counts: Counter = Counter()
+
+    def __enter__(self) -> "RecompilationSentinel":
+        self.counts = Counter()
+        _ACTIVE_SENTINELS.append(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _ACTIVE_SENTINELS.remove(self)
+
+    def count(self, name: str) -> int:
+        return self.counts[name]
+
+    def assert_traces(self, name: str, expected: int = 1) -> None:
+        got = self.counts[name]
+        if got != expected:
+            raise AssertionError(
+                f"'{name}' traced {got}x, expected {expected}x — "
+                f"a retrace crept into the hot path. All counts: "
+                f"{dict(self.counts) or '{}'}")
 
 
 @contextlib.contextmanager

@@ -1,42 +1,75 @@
 #!/bin/bash
-# Wait for the TPU relay to recover, then capture the full measurement
-# list sequentially (each script writes its own artifact). Run from the
-# repo root, ideally in the background:
+# CANONICAL parameterized TPU capture entry point.
+#
+#     bash scripts/tpu_capture.sh [step ...]
+#
+# Waits for the relay, then runs the named steps sequentially (one
+# relay session, strictly serial — the single-session relay wedges
+# under concurrent probes). With no arguments, runs the full default
+# list. Steps:
+#
+#   bench            bench.py                     -> TPU_BENCH_CAPTURE.json
+#   bench-unroll     BENCH_SCAN_UNROLL=4 bench.py (unroll A/B)
+#   bench-dispatch   BENCH_SINGLE_DISPATCH=0      (dispatch A/B)
+#   conv-ab          BENCH_CONV_IMPL=matmul|conv  (lowering A/B, both)
+#   zoo              scripts/tpu_zoo_check.py     -> TPU_ZOO.json
+#   pallas           scripts/pallas_tpu_check.py  -> PALLAS_TPU.json
+#   flash-train      scripts/flash_train_bench.py -> FLASH_TRAIN.json
+#   flash-sweep      scripts/flash_block_sweep.py -> FLASH_BLOCK_SWEEP.json
+#   vmap             scripts/vmap_penalty_bench.py -> VMAP_PENALTY.json
+#   mfu              scripts/mfu_sweep.py         -> MFU_SWEEP.json
+#   moe              scripts/moe_ab_bench.py      -> MOE_AB.json
+#   seqpar           scripts/seqpar_tpu_probe.py  -> SEQPAR_TPU_PROBE.json
+#   baseline         scripts/baseline_suite.py    -> BASELINE_SUITE.json
+#   curves           scripts/northstar_synthetic.py -> NORTHSTAR_CURVE_*.json
+#
+# This supersedes the per-round stage chains (tpu_capture_full.sh,
+# tpu_capture_r4*.sh, tpu_capture_r5*.sh) — kept for session history;
+# see ARTIFACTS.md "Capture scripts". A/B variants are ordered before
+# their defaults in the default list so the persisted default-config
+# record is written LAST (the wedged-relay report fallback reads it).
+#
+# Run from the repo root, ideally in the background:
 #     nohup bash scripts/tpu_capture.sh > /tmp/tpu_capture.log 2>&1 &
 # The probe uses bench.probe_device (subprocess + SIGTERM-safe timeout);
 # TPU_CAPTURE_WAIT_TRIES probes x 120 s (+120 s pauses) bound the wait.
 set -u
 cd "$(dirname "$0")/.." || exit 1
+. scripts/capture_lib.sh
 
 TRIES="${TPU_CAPTURE_WAIT_TRIES:-90}"   # ~6 h of patience by default
 
+DEFAULT_STEPS="bench-dispatch bench-unroll bench zoo pallas \
+flash-train vmap baseline"
+STEPS="${*:-$DEFAULT_STEPS}"
+
 echo "[tpu_capture] waiting for the relay (up to ${TRIES}x120s probes)"
-BENCH_PROBE_TRIES="$TRIES" python - <<'EOF'
-import sys
-sys.path.insert(0, ".")
-from bench import probe_device
-sys.exit(0 if probe_device() else 1)
-EOF
-if [ $? -ne 0 ]; then
+if ! probe_relay "$TRIES"; then
     echo "[tpu_capture] relay never recovered; nothing captured"
     exit 1
 fi
 
-echo "[tpu_capture] relay alive — capturing (each step sequential)"
+echo "[tpu_capture] relay alive — capturing: $STEPS"
 FAILED=0
-run() {
-    echo "=== $* ==="
-    # probes are already done; don't let per-script probes re-wait long
-    BENCH_PROBE_TRIES=2 "$@"
-    local rc=$?
-    echo "=== rc=$rc ==="
-    [ $rc -ne 0 ] && FAILED=1
-}
-
-run python bench.py
-run env BENCH_SCAN_UNROLL=4 python bench.py      # unroll A/B
-run python scripts/tpu_zoo_check.py              # -> TPU_ZOO.json
-run python scripts/vmap_penalty_bench.py         # -> VMAP_PENALTY.json
-run python scripts/baseline_suite.py             # -> BASELINE_SUITE.json
+for step in $STEPS; do
+    case "$step" in
+        bench)          run python bench.py ;;
+        bench-unroll)   run env BENCH_SCAN_UNROLL=4 python bench.py ;;
+        bench-dispatch) run env BENCH_SINGLE_DISPATCH=0 python bench.py ;;
+        conv-ab)        run env BENCH_CONV_IMPL=matmul python bench.py
+                        run env BENCH_CONV_IMPL=conv python bench.py ;;
+        zoo)            run python scripts/tpu_zoo_check.py ;;
+        pallas)         run python scripts/pallas_tpu_check.py ;;
+        flash-train)    run python scripts/flash_train_bench.py ;;
+        flash-sweep)    run python scripts/flash_block_sweep.py ;;
+        vmap)           run python scripts/vmap_penalty_bench.py ;;
+        mfu)            run python scripts/mfu_sweep.py ;;
+        moe)            run python scripts/moe_ab_bench.py ;;
+        seqpar)         run python scripts/seqpar_tpu_probe.py ;;
+        baseline)       run python scripts/baseline_suite.py ;;
+        curves)         run python scripts/northstar_synthetic.py ;;
+        *) echo "[tpu_capture] unknown step: $step"; FAILED=1 ;;
+    esac
+done
 echo "[tpu_capture] done (failed=$FAILED)"
 exit $FAILED

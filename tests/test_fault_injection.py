@@ -353,6 +353,28 @@ class TestSupervisor:
         assert sup.stats.loss_ema is not None
         assert int(s.round) == 3
 
+    def test_zero_participation_round_does_not_poison_loss_ema(self):
+        """An all-crash round carries no loss observation: its 0.0
+        must not decay the EMA (which would wedge the blow-up check
+        into rejecting every genuine round afterwards), and the round
+        loop's scalars must still be reusable from the health fetch."""
+        t = make_trainer()
+        sup = RoundSupervisor(t, sleep_fn=lambda s: None)
+        s, c = t.init_state(jax.random.key(0))
+        s, c, m = sup.run_round(s, c)
+        ema0 = sup.stats.loss_ema
+        assert ema0 is not None and ema0 > 0.0
+        assert sup.last_scalars is not None  # one-fetch reuse surface
+        assert sup.last_scalars["loss_sum"] > 0.0
+        # synthetic zero-participation health report
+        sup._note_healthy({"finite": True, "n": 0.0, "loss": 0.0,
+                           "round": 2})
+        assert sup.stats.loss_ema == ema0  # unchanged
+        # and the blow-up check ignores the empty round entirely
+        sup.fault = FaultConfig(loss_blowup_factor=2.0)
+        assert sup._healthy({"finite": True, "n": 0.0, "loss": 0.0,
+                             "round": 3})
+
     def test_loss_blowup_detection(self):
         """A loss far above the EMA triggers rollback even with finite
         params."""

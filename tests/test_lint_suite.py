@@ -1,0 +1,77 @@
+"""Tier-1 wrapper for the lint gate (scripts/lint_suite.py).
+
+Runs the full suite in-process — the custom analyzer is stdlib-only
+AST walking, so this stays in the fast lane — and pins down the gate
+semantics: clean tree passes, a NEW hazard fails, a baselined or
+suppressed one does not.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+import lint_suite  # noqa: E402
+
+
+def test_gate_is_clean():
+    """The checked-in tree must pass its own gate: no tracing-hazard
+    regressions vs the baseline (ruff half auto-skips when absent)."""
+    assert lint_suite.main([]) == 0
+
+
+def test_gate_fails_on_new_finding(tmp_path):
+    """A module with a fresh hazard (host sync on a jnp expression)
+    must fail the gate — the baseline only covers accepted history."""
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import jax.numpy as jnp\n"
+        "def f(x):\n"
+        "    return float(jnp.sum(x))\n")
+    rc = lint_suite.run_tracing_lint([str(bad), "--root", str(tmp_path)])
+    assert rc == 1
+
+
+def test_gate_respects_baseline(tmp_path):
+    """The same findings accepted into a baseline pass the gate."""
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import jax.numpy as jnp\n"
+        "def f(x):\n"
+        "    return float(jnp.sum(x))\n")
+    base = tmp_path / "base.json"
+    args = [str(bad), "--root", str(tmp_path), "--baseline", str(base)]
+    assert lint_suite.run_tracing_lint(
+        args + ["--write-baseline"]) == 0
+    assert lint_suite.run_tracing_lint(args) == 0
+    # a SECOND identical hazard exceeds the baselined multiset
+    bad.write_text(
+        "import jax.numpy as jnp\n"
+        "def f(x):\n"
+        "    return float(jnp.sum(x))\n"
+        "def g(x):\n"
+        "    return float(jnp.max(x))\n")
+    assert lint_suite.run_tracing_lint(args) == 1
+
+
+def test_cli_subcommand_entry():
+    """`python -m fedtorch_tpu.cli lint` routes to the analyzer
+    without initializing jax (it must stay importable/cheap)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "fedtorch_tpu.cli", "lint", "--explain"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0
+    assert "FTL001" in proc.stdout and "FTL005" in proc.stdout
+
+
+@pytest.mark.parametrize("rule", ["FTL001", "FTL002", "FTL003",
+                                  "FTL004", "FTL005"])
+def test_baseline_or_clean_per_rule(rule):
+    """Every rule class is live: the analyzer knows it and --explain
+    documents it (regression guard for the registry)."""
+    from fedtorch_tpu.lint.rules import RULES
+    assert rule in RULES
+    assert RULES[rule].hint

@@ -1,0 +1,138 @@
+"""Recompilation sentinel: the round program traces exactly once.
+
+The dynamic half of the tracing-hazard gate (static half:
+fedtorch_tpu.lint, tests/test_lint_*.py).  PR 1's chaos/guard
+machinery and the bench path both rest on "static config => unchanged
+traced program"; these tests make that contract executable: the FedAvg
+and SCAFFOLD round functions must trace exactly once across multiple
+rounds — fault-free AND under a chaos+guard schedule — and any future
+change that sneaks a retrace into the hot loop fails here.
+"""
+import jax
+import pytest
+
+from fedtorch_tpu.algorithms import make_algorithm
+from fedtorch_tpu.config import (
+    DataConfig, ExperimentConfig, FaultConfig, FederatedConfig,
+    ModelConfig, OptimConfig, TrainConfig,
+)
+from fedtorch_tpu.data import build_federated_data
+from fedtorch_tpu.models import define_model
+from fedtorch_tpu.parallel import FederatedTrainer
+from fedtorch_tpu.utils import (
+    RecompilationSentinel, instrument_trace, jit_cache_size,
+)
+
+
+def make_trainer(algorithm="fedavg", fault_kw=None, num_clients=8):
+    cfg = ExperimentConfig(
+        data=DataConfig(dataset="synthetic", synthetic_dim=10,
+                        batch_size=16, synthetic_alpha=0.5,
+                        synthetic_beta=0.5),
+        federated=FederatedConfig(
+            federated=True, num_clients=num_clients, num_comms=5,
+            online_client_rate=0.5, algorithm=algorithm,
+            sync_type="local_step"),
+        model=ModelConfig(arch="logistic_regression"),
+        optim=OptimConfig(lr=0.05, weight_decay=0.0),
+        train=TrainConfig(local_step=3),
+        fault=FaultConfig(**(fault_kw or {})),
+    ).finalize()
+    data = build_federated_data(cfg)
+    model = define_model(cfg, batch_size=cfg.data.batch_size)
+    alg = make_algorithm(cfg)
+    return FederatedTrainer(cfg, model, alg, data.train)
+
+
+@pytest.mark.parametrize("algorithm", ["fedavg", "scaffold"])
+def test_round_traces_exactly_once(algorithm):
+    """3+ rounds of the hot path: ONE trace, ONE compiled program."""
+    trainer = make_trainer(algorithm)
+    server, clients = trainer.init_state(jax.random.key(0))
+    with RecompilationSentinel() as s:
+        for _ in range(3):
+            server, clients, _ = trainer.run_round(server, clients)
+        # by round 3 every input is a committed device-resident
+        # donated output; the executable cache must stop growing
+        # (the first rounds add a fresh-input vs steady-state entry
+        # pair without retracing — the jaxpr is reused)
+        cache_steady = jit_cache_size(trainer._round_jit)
+        for _ in range(2):
+            server, clients, metrics = trainer.run_round(server, clients)
+        jax.block_until_ready(server.params)
+    s.assert_traces(trainer.trace_name, expected=1)
+    assert s.count(f"federated.round[{algorithm}]") == 1
+    cache_end = jit_cache_size(trainer._round_jit)
+    assert cache_end == cache_steady  # None == None when unavailable
+
+
+@pytest.mark.parametrize("algorithm", ["fedavg", "scaffold"])
+def test_round_traces_once_under_faults(algorithm):
+    """Chaos + guards are static config: the faulted round program
+    must also trace exactly once across rounds — the contract the
+    robustness layer (PR 1) depends on."""
+    trainer = make_trainer(algorithm, fault_kw=dict(
+        client_drop_rate=0.25, straggler_rate=0.25,
+        straggler_step_frac=0.5, nan_inject_rate=0.25,
+        guard_updates=True))
+    server, clients = trainer.init_state(jax.random.key(1))
+    with RecompilationSentinel() as s:
+        for _ in range(3):
+            server, clients, metrics = trainer.run_round(server, clients)
+        jax.block_until_ready(server.params)
+    s.assert_traces(trainer.trace_name, expected=1)
+
+
+def test_sentinel_catches_retraces():
+    """Positive control: the sentinel machinery itself must see a
+    retrace when one genuinely happens (new shape => new trace)."""
+    import jax.numpy as jnp
+
+    @jax.jit
+    @instrument_trace("sentinel_test.f")
+    def f(x):
+        return jnp.sum(x * 2)
+
+    with RecompilationSentinel() as s:
+        f(jnp.ones((4,)))
+        f(jnp.ones((4,)))      # cached: no retrace
+        f(jnp.ones((8,)))      # new shape: retrace
+    assert s.count("sentinel_test.f") == 2
+    with pytest.raises(AssertionError, match="traced 2x"):
+        s.assert_traces("sentinel_test.f", expected=1)
+
+
+def test_sentinel_scoping_and_nesting():
+    """Counts are scoped to the context: events before/after the
+    block are invisible, and sentinels nest independently."""
+    import jax.numpy as jnp
+
+    @jax.jit
+    @instrument_trace("sentinel_test.g")
+    def g(x):
+        return x + 1
+
+    g(jnp.ones((2,)))  # traced outside any sentinel
+    with RecompilationSentinel() as outer:
+        g(jnp.ones((2,)))  # cached — no event
+        with RecompilationSentinel() as inner:
+            g(jnp.ones((3,)))  # retrace — seen by both
+        g(jnp.ones((5,)))      # retrace — seen by outer only
+    assert inner.count("sentinel_test.g") == 1
+    assert outer.count("sentinel_test.g") == 2
+
+
+def test_run_rounds_scan_driver_traces_once():
+    """The multi-round lax.scan driver is its own single-trace
+    program (and does not re-trace the per-round program)."""
+    trainer = make_trainer("fedavg")
+    server, clients = trainer.init_state(jax.random.key(2))
+    with RecompilationSentinel() as s:
+        server, clients, ms = trainer.run_rounds(server, clients, 3)
+        jax.block_until_ready(server.params)
+        server, clients, ms = trainer.run_rounds(server, clients, 3)
+        jax.block_until_ready(server.params)
+    assert s.count("federated.rounds[fedavg]x3") == 1
+    # the scan body inlines round_fn directly — the per-round jit
+    # entry must not have been traced at all by the scan driver
+    assert s.count(trainer.trace_name) == 0
