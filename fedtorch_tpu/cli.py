@@ -105,11 +105,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Switch load-balance aux-loss weight (0.01 in "
                         "the paper); 0 disables and the gate can "
                         "collapse onto one expert")
-    p.add_argument("--attention", default="dense",
-                   choices=("dense", "flash"),
+    p.add_argument("--attention", default="auto",
+                   choices=("auto", "dense", "flash"),
                    help="transformer attention backend: 'flash' = fused "
                         "online-softmax pallas kernel on TPU (exact; "
-                        "dense fallback off-TPU)")
+                        "dense fallback off-TPU); 'auto' (default) "
+                        "picks flash only at sequence lengths where the "
+                        "on-chip A/B measured it winning (T >= 4096 — "
+                        "FLASH_TRAIN.json's T=2048 window regressed "
+                        "0.68x)")
     p.add_argument("--conv_impl", default="auto",
                    choices=("auto", "conv", "matmul"),
                    help="conv-family lowering (resnet/wideresnet/"
@@ -162,6 +166,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--use_nesterov", type=str2bool, default=False)
     p.add_argument("--weight_decay", type=float, default=5e-4)
     p.add_argument("--correct_wd", type=str2bool, default=False)
+    p.add_argument("--wd_skip_norm_bias", type=str2bool, default=False,
+                   help="exclude norm scale/shift and bias params from "
+                        "weight decay (standard practice); default "
+                        "False = the reference's uniform decay, which "
+                        "parity runs must keep")
     # misc / checkpoint (parameters.py:196-222)
     p.add_argument("--manual_seed", type=int, default=6)
     p.add_argument("--per_class_acc", type=str2bool, default=False)
@@ -237,6 +246,21 @@ def build_parser() -> argparse.ArgumentParser:
                    help="per-block rematerialization for resnet/"
                         "transformer: ~1.33x FLOPs for depth-independent "
                         "activation memory")
+    p.add_argument("--client_fusion", default="auto",
+                   choices=("auto", "vmap", "fused"),
+                   help="client-axis execution strategy for the round "
+                        "program's model compute: 'fused' packs the k "
+                        "online clients into one feature_group_count=k "
+                        "grouped conv per layer (k x the MXU lanes; "
+                        "resnet-cifar/cnn + norm=bn, 1-device mesh); "
+                        "'auto' currently keeps 'vmap' pending the "
+                        "on-chip A/B (docs/performance.md)")
+    p.add_argument("--allow_train_as_test", type=str2bool, default=False,
+                   help="permit dataset loaders with a missing test "
+                        "split (EMNIST mirrors) to substitute a slice "
+                        "of TRAIN data as the test set; off by default "
+                        "because it silently reports train accuracy "
+                        "as test accuracy")
     return p
 
 
@@ -255,7 +279,8 @@ def args_to_config(args) -> ExperimentConfig:
             base_batch_size=args.base_batch_size,
             max_batch_size=args.max_batch_size,
             reshuffle_per_epoch=args.reshuffle_per_epoch,
-            augment=args.augment),
+            augment=args.augment,
+            allow_train_as_test=args.allow_train_as_test),
         federated=FederatedConfig(
             federated=args.federated, num_clients=args.num_workers,
             num_comms=args.num_comms,
@@ -298,6 +323,7 @@ def args_to_config(args) -> ExperimentConfig:
             out_momentum_factor=args.out_momentum_factor,
             use_nesterov=args.use_nesterov,
             weight_decay=args.weight_decay, correct_wd=args.correct_wd,
+            wd_skip_norm_bias=args.wd_skip_norm_bias,
             lr_scale_at_sync=args.lr_scale_at_sync),
         lr_schedule=LRConfig(
             schedule_scheme=args.lr_schedule_scheme,
@@ -339,7 +365,8 @@ def args_to_config(args) -> ExperimentConfig:
             coordinator_address=args.coordinator_address,
             num_processes=args.num_processes, process_id=args.process_id,
             compute_dtype=args.compute_dtype,
-            scan_unroll=args.scan_unroll, remat=args.remat),
+            scan_unroll=args.scan_unroll, remat=args.remat,
+            client_fusion=args.client_fusion),
         fault=FaultConfig(
             client_drop_rate=args.fault_client_drop_rate,
             straggler_rate=args.fault_straggler_rate,

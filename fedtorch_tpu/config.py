@@ -74,6 +74,10 @@ class DataConfig:
     # train-time flip+crop augmentation (prepare_data.py:29-35 applies it
     # for the cifar family); None = on for cifar/stl10, off otherwise
     augment: Optional[bool] = None
+    # EMNIST ships train-only in some mirrors; slicing train rows in as
+    # a fake test set silently reports train accuracy as test accuracy,
+    # so the fallback is opt-in (data/datasets.py raises without it)
+    allow_train_as_test: bool = False
 
 
 @dataclass(frozen=True)
@@ -152,10 +156,14 @@ class ModelConfig:
     # N-lane roofline predicts a larger MXU win; conv elsewhere (the
     # kh*kw x patch-memory trade is prohibitive at 96px+ inputs).
     conv_impl: str = "auto"
-    # transformer attention backend: 'dense' (materialized scores) or
+    # transformer attention backend: 'dense' (materialized scores),
     # 'flash' (fused online-softmax pallas kernel on TPU, O(block^2)
-    # score memory; exact, dense fallback off-TPU)
-    attention: str = "dense"
+    # score memory; exact, dense fallback off-TPU), or 'auto'
+    # (default): per-sequence-length dispatch that picks flash only
+    # where the on-chip training A/B measured it winning outside the
+    # noise band (T >= 4096; FLASH_TRAIN.json read 0.68x at T=2048 —
+    # ops/attention_dispatch.py:resolve_attention)
+    attention: str = "auto"
     pretrained: bool = False
     # 'robust_*' archs learn an adversarial input-noise parameter.
     robust_noise_ascent_lr: float = 0.1
@@ -175,6 +183,13 @@ class OptimConfig:
     dampening: float = 0.0
     weight_decay: float = 5e-4
     correct_wd: bool = False  # AdamW decoupled weight decay switch
+    # True excludes normalization scale/shift and bias parameters from
+    # weight decay (the standard deep-learning practice). Default False
+    # = the reference's uniform decay over every parameter
+    # (sgd.py:96-101 applies wd to the whole param group, BN included)
+    # — parity runs against the reference need the biased-but-faithful
+    # behavior, so the exclusion is opt-in (core/optim.py).
+    wd_skip_norm_bias: bool = False
     lr_scale_at_sync: float = 1.0
     adam_beta1: float = 0.9
     adam_beta2: float = 0.999
@@ -339,6 +354,26 @@ class MeshConfig:
     # one block instead of the depth — the standard TPU HBM lever for
     # deep models / long sequences. Same values, same gradients.
     remat: bool = False
+    # Client-axis execution strategy for the per-client model compute
+    # inside the jitted round program (docs/performance.md
+    # "Client-fused MXU execution"):
+    #   'vmap'  — vmap model.apply over the k online clients (each
+    #             client's 16-64-channel conv tiles the MXU separately;
+    #             the certified round-5 program identity);
+    #   'fused' — pack the k clients into the channel axis and run ONE
+    #             feature_group_count=k grouped conv per layer (k x the
+    #             MXU lanes per pass; numerics-equivalent, pinned by
+    #             tests/test_client_fusion.py). Supported for the
+    #             resnet-cifar family + cnn with norm='bn' on a
+    #             single-device mesh and base-local-step algorithms;
+    #             requesting it elsewhere raises with the reason;
+    #   'auto'  — currently resolves to 'vmap': the fused lowering is
+    #             built and CPU-proven but its on-chip win is still
+    #             unmeasured (scripts/mfu_sweep.py fused configs are
+    #             armed for the next relay window), and this repo does
+    #             not flip defaults ahead of chip data — the conv_impl
+    #             lesson (docs/performance.md "Conv-lowering decision").
+    client_fusion: str = "auto"
 
 
 @dataclass(frozen=True)
@@ -422,6 +457,14 @@ class ExperimentConfig:
             raise ValueError(
                 f"model.conv_impl must be 'auto', 'conv' or 'matmul', "
                 f"got {self.model.conv_impl!r}")
+        if self.model.attention not in ("auto", "dense", "flash"):
+            raise ValueError(
+                f"model.attention must be 'auto', 'dense' or 'flash', "
+                f"got {self.model.attention!r}")
+        if self.mesh.client_fusion not in ("auto", "vmap", "fused"):
+            raise ValueError(
+                f"mesh.client_fusion must be 'auto', 'vmap' or 'fused', "
+                f"got {self.mesh.client_fusion!r}")
         flt = self.fault
         for name in ("client_drop_rate", "straggler_rate",
                      "nan_inject_rate"):

@@ -50,6 +50,38 @@ def init_adam(params) -> AdamState:
                      step=jnp.zeros((), jnp.int32), out_buf=z())
 
 
+def _wd_coef(cfg: OptimConfig):
+    """Per-leaf weight-decay coefficient function.
+
+    The reference decays EVERY parameter uniformly (sgd.py:96-101
+    applies wd to the whole param group — BatchNorm scale/shift and
+    biases included), so that stays the default: parity runs against
+    the reference would otherwise silently drift. With
+    ``cfg.wd_skip_norm_bias`` the standard exclusion applies instead:
+    leaves named 'scale' (the zoo's norm layers — BatchStatsNorm and
+    GroupNorm both name their affine pair scale/bias) or 'bias' (norm
+    shifts and layer biases) get coefficient 0. Resolved from STATIC
+    tree paths, so it is free under jit/vmap."""
+    wd = cfg.weight_decay
+
+    def coef(path):
+        if cfg.wd_skip_norm_bias:
+            last = path[-1]
+            name = getattr(last, "key", getattr(last, "name", None))
+            if name in ("scale", "bias"):
+                return 0.0
+        return wd
+
+    return coef
+
+
+def apply_weight_decay(grads, params, cfg: OptimConfig):
+    """grads + wd * params, with the per-leaf coefficient rule above."""
+    coef = _wd_coef(cfg)
+    return jax.tree_util.tree_map_with_path(
+        lambda path, g, p: g + coef(path) * p, grads, params)
+
+
 def _momentum_update(buf, d, factor, dampening, nesterov):
     """buf <- factor*buf + (1-dampening)*d ; returns (direction, new_buf).
 
@@ -72,8 +104,7 @@ def sgd_local_step(params, grads, state: SGDState, lr, cfg: OptimConfig):
     `lr` may be a traced scalar (per-step scheduled LR).
     """
     if cfg.weight_decay:
-        grads = jax.tree.map(lambda g, p: g + cfg.weight_decay * p,
-                             grads, params)
+        grads = apply_weight_decay(grads, params, cfg)
     in_buf = state.in_buf
     if cfg.in_momentum and cfg.in_momentum_factor:
         grads, in_buf = _momentum_update(
@@ -105,8 +136,7 @@ def adam_local_step(params, grads, state: AdamState, lr, cfg: OptimConfig):
     b1, b2 = cfg.adam_beta1, cfg.adam_beta2
     if cfg.weight_decay and not cfg.correct_wd:
         # Classic L2-into-gradient (adam.py:77-78 when not correct_wd).
-        grads = jax.tree.map(lambda g, p: g + cfg.weight_decay * p,
-                             grads, params)
+        grads = apply_weight_decay(grads, params, cfg)
     exp_avg = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g,
                            state.exp_avg, grads)
     exp_avg_sq = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * g * g,
@@ -115,14 +145,18 @@ def adam_local_step(params, grads, state: AdamState, lr, cfg: OptimConfig):
     bc2 = 1 - b2 ** step.astype(jnp.float32)
     step_size = lr * jnp.sqrt(bc2) / bc1
 
-    def upd(p, m, v):
+    coef = _wd_coef(cfg)
+
+    def upd(path, p, m, v):
         new_p = p - step_size * m / (jnp.sqrt(v) + cfg.adam_eps)
         if cfg.weight_decay and cfg.correct_wd:
-            # Decoupled weight decay (adam.py:96-97).
-            new_p = new_p - lr * cfg.weight_decay * p
+            # Decoupled weight decay (adam.py:96-97), same per-leaf
+            # coefficient rule as the L2 form.
+            new_p = new_p - lr * coef(path) * p
         return new_p
 
-    new_params = jax.tree.map(upd, params, exp_avg, exp_avg_sq)
+    new_params = jax.tree_util.tree_map_with_path(upd, params, exp_avg,
+                                                  exp_avg_sq)
     return new_params, AdamState(exp_avg=exp_avg, exp_avg_sq=exp_avg_sq,
                                  step=step, out_buf=state.out_buf)
 

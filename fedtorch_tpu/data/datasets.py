@@ -175,23 +175,36 @@ def load_cifar(dataset: str, data_dir: str,
 # -- TFF federated HDF5 (EMNIST / Shakespeare) ------------------------------
 
 def load_emnist(data_dir: str, full: bool = False,
-                download: bool = False) -> DatasetSplits:
+                download: bool = False,
+                allow_train_as_test: bool = False) -> DatasetSplits:
     """TFF fed_emnist HDF5: naturally-federated handwriting, 3383 writers
-    (digits) / 3400 (full, 62 classes) (ref: federated_datasets.py:15-138)."""
+    (digits) / 3400 (full, 62 classes) (ref: federated_datasets.py:15-138).
+
+    Some mirrors ship only the train archive. Substituting a slice of
+    TRAIN rows as the test set silently reports train accuracy as test
+    accuracy, so that fallback requires the explicit
+    ``allow_train_as_test`` opt-in (``--allow_train_as_test``) and
+    raises otherwise."""
     import h5py
     name = "fed_emnist" if full else "fed_emnist_digitsonly"
     base = os.path.join(data_dir, "emnist_full" if full else "emnist")
     train_p = os.path.join(base, f"{name}_train.h5")
     test_p = os.path.join(base, f"{name}_test.h5")
-    for p, url_key in ((train_p, "emnist_full" if full else "emnist"),):
+    url_key = "emnist_full" if full else "emnist"
+    # the archive holds BOTH splits, so a missing test file (train-only
+    # mirror) is also repaired by --download — the error below
+    # advertises exactly that remediation
+    for p in (train_p, test_p):
         if not os.path.exists(p):
             if download:
                 archive = os.path.join(base, os.path.basename(URLS[url_key]))
                 _fetch(URLS[url_key], archive)
                 with tarfile.open(archive, "r:bz2") as tf:
                     tf.extractall(base)
-            else:
-                raise _missing("emnist_full" if full else "emnist", train_p)
+            elif p == train_p:
+                raise _missing(url_key, train_p)
+            # test split missing without --download: the explicit
+            # opt-in fallback below decides
 
     def read(path):
         xs, ys, parts = [], [], []
@@ -213,9 +226,18 @@ def load_emnist(data_dir: str, full: bool = False,
     if os.path.exists(test_p):
         test_x, test_y, _ = read(test_p)
     else:
+        if not allow_train_as_test:
+            raise FileNotFoundError(
+                f"EMNIST test split missing: {test_p}. Refusing to "
+                "silently substitute training rows as the test set — "
+                "that reports train accuracy as test accuracy. Fetch "
+                "the full archive (--download), or opt in explicitly "
+                "with --allow_train_as_test if a train-slice pseudo "
+                "test set is acceptable for this run.")
         import sys as _sys
         print(f"warning: {test_p} missing — using a 256-sample slice of "
-              "the training data as the test set", file=_sys.stderr)
+              "the training data as the test set (allow_train_as_test "
+              "opt-in)", file=_sys.stderr)
         test_x, test_y = train_x[:256], train_y[:256]
     return DatasetSplits(train_x, train_y, test_x, test_y,
                          client_partitions=parts)
@@ -516,7 +538,8 @@ def get_dataset(cfg: DataConfig, num_clients: int,
         return load_cifar(name, root, download)
     if name in ("emnist", "emnist_full"):
         return load_emnist(root, full=(name == "emnist_full"),
-                           download=download)
+                           download=download,
+                           allow_train_as_test=cfg.allow_train_as_test)
     if name == "shakespeare":
         return load_shakespeare(root, seq_len=seq_len, download=download)
     if name in _LIBSVM_FILES:

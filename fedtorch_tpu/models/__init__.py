@@ -11,7 +11,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from fedtorch_tpu.config import ExperimentConfig
-from fedtorch_tpu.models.cnn import CNN
+from fedtorch_tpu.models.cnn import CNN, FusedCNN
 from fedtorch_tpu.models.common import (
     CONVEX_DIMS, REGRESSION_DIMS, ModelDef, flat_input_size, image_shape,
     num_classes_of,
@@ -22,7 +22,8 @@ from fedtorch_tpu.models.linear import (
 )
 from fedtorch_tpu.models.mlp import MLP
 from fedtorch_tpu.models.resnet import (
-    ResNetCifar, ResNetImageNet, build_resnet,
+    FusedResNetCifar, ResNetCifar, ResNetImageNet, build_fused_resnet,
+    build_resnet,
 )
 from fedtorch_tpu.models.rnn import CharGRU
 from fedtorch_tpu.models.wideresnet import WideResNet, build_wideresnet
@@ -95,6 +96,34 @@ def resolve_conv_impl(conv_impl: str, arch: str, dataset: str,
     except NotImplementedError:
         return "conv"
     return "matmul" if max(h, w) <= 64 else "conv"
+
+
+def define_fused_model(cfg: ExperimentConfig,
+                       num_clients: int) -> "object | None":
+    """Client-fused module for ``cfg.mesh.client_fusion='fused'``.
+
+    Returns a flax module whose parameter tree is the vmap path's
+    per-client tree stacked on a leading ``[num_clients]`` axis and
+    whose ``apply`` maps stacked ``[k, B, ...]`` inputs to
+    ``[k, B, classes]`` logits through ``feature_group_count=k``
+    grouped convolutions (models/common.py "client-fused layers"), or
+    ``None`` when the (arch, dataset, norm) triple has no fused form —
+    the engine's fusion gate (parallel/fusion.py) then keeps the vmap
+    strategy. Fusion is a different lowering of the SAME math, so the
+    ``conv_impl`` toggle does not apply to it."""
+    arch, dataset, m = cfg.model.arch, cfg.data.dataset, cfg.model
+    if arch.startswith("resnet"):
+        return build_fused_resnet(arch, dataset, num_clients, m.norm,
+                                  dtype=cfg.mesh.compute_dtype,
+                                  remat=cfg.mesh.remat)
+    if arch == "cnn":
+        try:
+            image_shape(dataset)
+        except NotImplementedError:
+            return None
+        return FusedCNN(dataset=dataset, num_clients=num_clients,
+                        dtype=cfg.mesh.compute_dtype)
+    return None
 
 
 def define_model(cfg: ExperimentConfig, batch_size: int = 2) -> ModelDef:

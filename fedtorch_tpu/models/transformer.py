@@ -40,13 +40,23 @@ import jax.numpy as jnp
 class _SelfAttention(nn.Module):
     num_heads: int
     dtype: str = "float32"
-    attention: str = "dense"  # 'dense' | 'flash' (pallas kernel on TPU)
+    # 'dense' | 'flash' (pallas kernel on TPU) | 'auto' (per-sequence-
+    # length dispatch: flash only at T >= FLASH_MIN_SEQ_LEN, where the
+    # on-chip A/B measured it winning — the T=2048 window regressed
+    # 0.68x and must never hit users by default; see
+    # ops/attention_dispatch.py:resolve_attention)
+    attention: str = "dense"
 
     @nn.compact
     def __call__(self, x, attn_override=None):
+        # pallas-free policy import: the dense path must not pull in
+        # the kernel stack (ops/attention_dispatch.py)
+        from fedtorch_tpu.ops.attention_dispatch import resolve_attention
         dt = jnp.dtype(self.dtype)
         d_model = x.shape[-1]
         head_dim = d_model // self.num_heads
+        # x.shape[1] is static under jit, so the dispatch is trace-time
+        attention = resolve_attention(self.attention, x.shape[1])
         qkv = nn.Dense(3 * d_model, use_bias=False, dtype=dt,
                        name="qkv")(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -55,7 +65,7 @@ class _SelfAttention(nn.Module):
         if attn_override is not None:
             # sequence-parallel ring attention ([B, T, H, D] in/out)
             out = attn_override(q, k, v)
-        elif self.attention == "flash":
+        elif attention == "flash":
             # fused online-softmax kernel: O(block^2) score memory, one
             # HBM write (ops/pallas/flash_attention.py; exact, with a
             # dense fallback off-TPU)

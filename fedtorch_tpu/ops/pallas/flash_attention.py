@@ -46,6 +46,13 @@ from jax.experimental.pallas import tpu as pltpu
 # production TPU flash kernels use; lane 0 is the value.
 _LANES = 128
 
+# dispatch policy ('auto' backend selection) lives in the pallas-free
+# ops/attention_dispatch.py so the dense path never imports this
+# module; re-exported here for kernel-side callers
+from fedtorch_tpu.ops.attention_dispatch import (  # noqa: E402,F401
+    FLASH_MIN_SEQ_LEN, resolve_attention,
+)
+
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
                 acc_scr, *, scale: float, causal: bool):

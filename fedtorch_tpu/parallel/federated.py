@@ -48,17 +48,20 @@ import jax.numpy as jnp
 from fedtorch_tpu.algorithms.base import (FedAlgorithm, num_online_effective)
 from fedtorch_tpu.config import ExperimentConfig
 from fedtorch_tpu.core import optim
-from fedtorch_tpu.core.losses import make_criterion, per_sample_loss
+from fedtorch_tpu.core.losses import (
+    accuracy, make_criterion, per_sample_loss,
+)
 from fedtorch_tpu.core.schedule import LRSchedule, compile_schedule, lr_at
 from fedtorch_tpu.core.state import (
-    ClientState, RoundMetrics, ServerState, tree_bytes, tree_sub,
-    tree_where, tree_zeros_like,
+    ClientState, RoundMetrics, ServerState, tree_broadcast_clients,
+    tree_bytes, tree_sub, tree_where, tree_zeros_like,
 )
 from fedtorch_tpu.data.batching import (
     ClientData, epoch_permutation, pad_client_axis, take_batch,
 )
 from fedtorch_tpu.models.common import ModelDef
 from fedtorch_tpu.ops.augment import augment_image_batch
+from fedtorch_tpu.parallel.fusion import resolve_client_fusion
 from fedtorch_tpu.parallel.mesh import (
     make_mesh, padded_client_count, replicate, shard_clients,
 )
@@ -159,6 +162,15 @@ class FederatedTrainer:
         self.mesh = mesh if mesh is not None else make_mesh(
             cfg.mesh, self.num_clients)
         algorithm.mesh_devices = int(self.mesh.devices.size)
+        # client-axis execution strategy (parallel/fusion.py): 'fused'
+        # swaps the vmapped per-client model compute for ONE
+        # feature_group_count=k grouped conv per layer — k x the MXU
+        # lanes on the 16-64-channel north-star convs. The fused module
+        # consumes the stacked per-client params unchanged;
+        # _fused_client_round keeps every [k] state semantic.
+        self.client_fusion, self.fused_module = resolve_client_fusion(
+            cfg, model, algorithm, int(self.mesh.devices.size),
+            self.k_online)
         # the client axis is padded up to a multiple of the mesh size with
         # inert (never-sampled, size-0) clients so EVERY device holds an
         # equal shard — no chip idles when num_clients has no large
@@ -428,9 +440,19 @@ class FederatedTrainer:
             return payload, delta, new_state, (
                 jnp.sum(losses * act) / n_act, jnp.sum(accs * act) / n_act)
 
-        payloads, deltas, new_on_clients, (losses, accs) = jax.vmap(
-            client_round)(on_clients, on_x, on_y, on_vx, on_vy, on_sizes,
-                          on_vsizes, weights, rngs, plan.budget_scale)
+        if self.client_fusion == "fused":
+            # same per-client math, one grouped conv per layer — the
+            # fusion gate guarantees the features the fused step does
+            # not thread (val batches, full loss, rnn carry) are off
+            payloads, deltas, new_on_clients, (losses, accs) = \
+                self._fused_client_round(server, on_clients, on_x, on_y,
+                                         on_sizes, weights, rngs,
+                                         plan.budget_scale)
+        else:
+            payloads, deltas, new_on_clients, (losses, accs) = jax.vmap(
+                client_round)(on_clients, on_x, on_y, on_vx, on_vy,
+                              on_sizes, on_vsizes, weights, rngs,
+                              plan.budget_scale)
 
         # poison chaos: the client's UPLOAD goes non-finite (its local
         # state stays sane — the fault is at the wire, so ``deltas``
@@ -553,6 +575,128 @@ class FederatedTrainer:
             rejected_updates=jnp.asarray(rejected, jnp.float32),
             clipped_updates=jnp.asarray(clipped, jnp.float32))
         return new_server, new_clients, metrics
+
+    # -- fused client round (cfg.mesh.client_fusion='fused') --------------
+    def _fused_client_round(self, server, on_clients, x, y, sizes,
+                            weights, rngs, budget_scale):
+        """``client_round`` for the fused client-axis strategy: one
+        scan whose body computes ALL k online clients' forward/backward
+        through the client-fused module (``feature_group_count=k``
+        grouped convs, models/common.py "client-fused layers") while
+        every per-client algorithm hook — extra_loss, transform_grads,
+        the optimizer step, client_payload — still runs under ``vmap``
+        on the stacked [k] state, so hook numerics stay per-client
+        exact for arbitrary hook code. Freeze masks (epoch-sync early
+        exit, straggler cuts), PRNG folds, masked metrics and payload
+        semantics mirror ``client_round`` line for line;
+        tests/test_client_fusion.py pins the A/B against the vmap
+        path."""
+        cfg, model, alg = self.cfg, self.model, self.algorithm
+        K, B, k = self.local_steps, self.batch_size, self.k_online
+        flt = self.fault
+        batch_mode = self.gather_mode == "batch"
+        server_params = server.params
+        nb = jnp.ceil(sizes / B)  # [k] batches per local epoch
+
+        if not batch_mode:
+            perms = jax.vmap(
+                lambda r, s: epoch_permutation(
+                    jax.random.fold_in(r, 0), s, x.shape[1])
+            )(rngs, sizes)
+
+        # per-client effective step counts (see client_round)
+        step_budget = (nb.astype(jnp.int32)
+                       * cfg.federated.num_epochs_per_comm) \
+            if self.epoch_sync else jnp.full((k,), K, jnp.int32)
+        if flt.straggler_rate > 0.0:
+            step_budget = jnp.maximum(jnp.ceil(
+                step_budget.astype(jnp.float32) * budget_scale), 1.0) \
+                .astype(jnp.int32)
+
+        fused = self.fused_module
+        lrs_of = jax.vmap(lambda e: lr_at(self.schedule, e))
+
+        def step(carry, kk):
+            params, opt, aux, epoch, li = carry
+            active = (kk < step_budget) if self.mask_steps \
+                else jnp.ones((k,), bool)
+            lr = lrs_of(epoch)  # [k]
+            if batch_mode:
+                bx = jax.lax.dynamic_slice_in_dim(x, kk * B, B, axis=1)
+                by = jax.lax.dynamic_slice_in_dim(y, kk * B, B, axis=1)
+            else:
+                bx, by = jax.vmap(
+                    lambda xc, yc, p, s: take_batch(xc, yc, p, s, kk, B)
+                )(x, y, perms, sizes)
+            if self.augment:
+                # client_round's exact fold chain: disjoint parent
+                # 0x7FFFFFFF, then the step index
+                aug = jax.vmap(lambda r: jax.random.fold_in(
+                    jax.random.fold_in(r, 0x7FFFFFFF), kk))(rngs)
+                bx = jax.vmap(augment_image_batch)(aug, bx)
+
+            def loss_fn(p):
+                logits = fused.apply({"params": p}, bx, train=True)
+                # [k, B] per-sample NLL spelled out (per_sample_loss's
+                # 2-D branch is the rnn time-mean, not a client axis)
+                logp = jax.nn.log_softmax(logits)
+                per = -jnp.take_along_axis(
+                    logp, by[..., None].astype(jnp.int32),
+                    axis=-1)[..., 0]
+                # criterion per client (mean over the batch axis) +
+                # the per-client extra loss (FedProx-style terms)
+                loss_k = jnp.mean(per, axis=1) + jax.vmap(
+                    lambda pc, ac: alg.extra_loss(pc, server_params, ac)
+                )(p, aux)
+                # clients are independent, so the grad of the SUM is
+                # each client's own grad — the stacked [k] twin of the
+                # vmapped value_and_grad
+                return jnp.sum(loss_k), (loss_k, logits)
+
+            (_, (loss_k, logits)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            grads = jax.vmap(
+                lambda g, pc, ac, l: alg.transform_grads(
+                    g, params=pc, server_params=server_params,
+                    client_aux=ac, server_aux=server.aux, lr=l)
+            )(grads, params, aux, lr)
+            n_params, n_opt = jax.vmap(
+                lambda pc, g, o, l: optim.local_step(pc, g, o, l,
+                                                     cfg.optim)
+            )(params, grads, opt, lr)
+            if self.mask_steps:
+                n_params = tree_where(active, n_params, params)
+                n_opt = tree_where(active, n_opt, opt)
+            af = active.astype(jnp.float32)
+            acc_k = jax.vmap(accuracy)(logits, by)
+            return (n_params, n_opt, aux, epoch + af / nb,
+                    li + active.astype(li.dtype)), (loss_k, acc_k, af)
+
+        init = (tree_broadcast_clients(server_params, k),
+                on_clients.opt, on_clients.aux, on_clients.epoch,
+                on_clients.local_index)
+        (params, opt, aux, epoch, li), (losses, accs, act) = \
+            jax.lax.scan(step, init, jnp.arange(K),
+                         unroll=min(cfg.mesh.scan_unroll, K))
+
+        # delta = server - params, leaf-broadcast over the stacked [k]
+        # axis (same helper as the vmap path so the convention cannot
+        # drift between the two strategies)
+        deltas = tree_sub(server_params, params)
+        lr_end = lrs_of(epoch)
+        payloads, aux = jax.vmap(
+            lambda d, a, pc, l, sb, w: alg.client_payload(
+                delta=d, client_aux=a, params=pc,
+                server_params=server_params, server_aux=server.aux,
+                lr=l, local_steps=sb, weight=w, full_loss=None)
+        )(deltas, aux, params, lr_end, step_budget, weights)
+        new_states = ClientState(params=params, opt=opt, aux=aux,
+                                 epoch=epoch, local_index=li)
+        # metrics over the steps each client actually took
+        n_act = jnp.maximum(jnp.sum(act, axis=0), 1.0)
+        return payloads, deltas, new_states, (
+            jnp.sum(losses * act, axis=0) / n_act,
+            jnp.sum(accs * act, axis=0) / n_act)
 
     def _mean_epoch_dev(self, clients) -> jnp.ndarray:
         """Device-side mean training epoch over the REAL clients — the

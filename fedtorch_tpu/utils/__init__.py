@@ -15,5 +15,6 @@ from fedtorch_tpu.utils.compile_cache import (  # noqa: F401
 )
 from fedtorch_tpu.utils.platform import honor_platform_env  # noqa: F401
 from fedtorch_tpu.utils.tracing import (  # noqa: F401
-    RecompilationSentinel, instrument_trace, trace_counts,
+    RecompilationSentinel, capture_round_trace, instrument_trace,
+    trace_counts,
 )

@@ -112,6 +112,47 @@ def trace(log_dir: str):
         jax.profiler.stop_trace()
 
 
+def fetch_sync(out):
+    """Force real completion of ``out`` (any pytree) via a 1-element
+    device->host fetch of its first leaf; returns the fetched value.
+
+    THE canonical drain for timing/tracing boundaries:
+    ``jax.block_until_ready`` can no-op on the relay backend
+    (round-5 timing-methodology finding, BASELINE_REPRO.md), while
+    materializing result bytes on the host provably waits for the
+    in-order device stream. ``scripts/bench_timing.py`` re-exports
+    this for the measurement scripts — one implementation, so the
+    rule cannot drift between the bench timers and the trace hook."""
+    import numpy as np
+
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    # lint: disable=FTL001 — this 1-element fetch IS the sync
+    return np.asarray(leaf[(0,) * getattr(leaf, "ndim", 0)])
+
+
+def capture_round_trace(log_dir: str, fn: Callable, *args):
+    """Run ``fn(*args)`` under a ``jax.profiler`` trace written to
+    ``log_dir`` and return its result — the canonical on-chip capture
+    hook for the round program (scripts/mfu_sweep.py, the MFU_PROFILE
+    arm of scripts/tpu_capture.sh).
+
+    The result is drained INSIDE the trace window by :func:`fetch_sync`
+    (block_until_ready can no-op on the relay backend): a trace
+    stopped before the device stream finishes records dispatch, not
+    execution — the exact failure mode that left round 5 with zero
+    on-chip traces."""
+    import os
+
+    os.makedirs(log_dir, exist_ok=True)
+    jax.profiler.start_trace(log_dir)
+    try:
+        out = fn(*args)
+        fetch_sync(out)
+    finally:
+        jax.profiler.stop_trace()
+    return out
+
+
 def annotate(name: str):
     """Named sub-span inside a trace (shows up on the TB timeline)."""
     return jax.profiler.TraceAnnotation(name)

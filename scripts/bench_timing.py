@@ -8,20 +8,16 @@ BYTES on the host provably waits for the in-order device stream, so
 every micro-benchmark syncs by fetching one element of its final
 output. Import from here — a copy-pasted variant that drifts back to
 block_until_ready silently resumes reading artifact timings.
+
+The implementation lives in ``fedtorch_tpu.utils.tracing.fetch_sync``
+(one copy — the profiler trace hook drains through the same rule);
+this module stays the scripts-facing import surface.
 """
 from __future__ import annotations
 
 import time
 
-
-def sync(out):
-    """Force real completion of `out` (any pytree) via a 1-element
-    device->host fetch of its first leaf; returns the fetched value."""
-    import jax
-    import numpy as np
-
-    leaf = jax.tree_util.tree_leaves(out)[0]
-    return np.asarray(leaf[(0,) * getattr(leaf, "ndim", 0)])
+from fedtorch_tpu.utils.tracing import fetch_sync as sync  # noqa: F401
 
 
 def timeit(fn, *args, iters: int = 20) -> float:

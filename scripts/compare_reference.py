@@ -201,12 +201,17 @@ def run_ours(algo: str, rounds: int, cx, cy, tx, ty,
     # each ~2.3s, rounds 2+ ~1ms)
     s, c, _ = trainer.run_round(server, clients)
     s, c, _ = trainer.run_round(s, c)
-    jax.block_until_ready(s.params)  # drain warmup before the timer
+    # drain warmup / close the timed segment with a fetch-sync:
+    # jax.block_until_ready can no-op on the relay backend, which
+    # inflates the speedup by timing dispatch instead of execution
+    # (scripts/bench_timing.py, round-5 methodology finding)
+    from bench_timing import sync as bench_sync
+    bench_sync(s.params)
     server, clients = trainer.init_state(jax.random.key(6))
     t0 = time.time()
     for _ in range(rounds):
         server, clients, _ = trainer.run_round(server, clients)
-    jax.block_until_ready(server.params)
+    bench_sync(server.params)
     wall = time.time() - t0
     tr = evaluate(model, server.params, feats, labels, batch_size=200)
     te = evaluate(model, server.params, tx, ty, batch_size=200)

@@ -21,6 +21,13 @@ MFU for each:
   resnet50      B=50 — bottleneck blocks reach 256 output channels,
                 escaping the N-lane roofline bound (underfill is the
                 benchmark model, not the engine)
+  fused         B=50 client_fusion=fused — the k online clients packed
+                into ONE feature_group_count=k grouped conv per layer
+                (k x the MXU lanes per pass; CPU-proven bitwise
+                equivalent, tests/test_client_fusion.py) — round 6's
+                utilization lever
+  fused_online20  B=50 k=20 fused — 20 x the lanes
+  fused128      B=128 fused — rows AND lanes together
 
 MFU accounting: per-local-step FLOPs come from XLA's cost analysis of
 the compiled fwd+bwd of the ``conv_impl='conv'`` lowering — the
@@ -121,6 +128,7 @@ def measured_flops_per_step(model, batch, cache_key=None):
 
 def run_config(name, *, batch, dtype="bfloat16", unroll=1,
                online_rate=0.1, conv_impl="conv", arch="resnet20",
+               client_fusion="auto", num_devices=None,
                profile_dir=None):
     import jax
     from fedtorch_tpu.algorithms import make_algorithm
@@ -131,6 +139,7 @@ def run_config(name, *, batch, dtype="bfloat16", unroll=1,
     from fedtorch_tpu.data.batching import stack_partitions
     from fedtorch_tpu.models import define_model
     from fedtorch_tpu.parallel import FederatedTrainer
+    from fedtorch_tpu.utils.tracing import capture_round_trace
 
     cfg = ExperimentConfig(
         data=DataConfig(dataset="cifar10", batch_size=batch),
@@ -141,7 +150,12 @@ def run_config(name, *, batch, dtype="bfloat16", unroll=1,
         model=ModelConfig(arch=arch, conv_impl=conv_impl),
         optim=OptimConfig(lr=0.1, in_momentum=True),
         train=TrainConfig(local_step=LOCAL_STEPS),
-        mesh=MeshConfig(compute_dtype=dtype, scan_unroll=unroll),
+        # num_devices: the sweep measures one chip; CPU smoke tests of
+        # the fused plumbing pin 1 (their virtual test mesh exposes 8
+        # devices, and the fused lowering never shards the client axis)
+        mesh=MeshConfig(compute_dtype=dtype, scan_unroll=unroll,
+                        client_fusion=client_fusion,
+                        num_devices=num_devices),
     ).finalize()
 
     samples = max(250, batch)  # each client must cover one full batch
@@ -153,6 +167,10 @@ def run_config(name, *, batch, dtype="bfloat16", unroll=1,
              for i in range(NUM_CLIENTS)]
     data = stack_partitions(feats, labels, parts)
 
+    # fetch-synced timing (scripts/bench_timing.py): block_until_ready
+    # can no-op on the relay backend (round-5 methodology finding)
+    from bench_timing import sync as bench_sync
+
     model = define_model(cfg, batch_size=batch)
     trainer = FederatedTrainer(cfg, model, make_algorithm(cfg), data)
     server, clients = trainer.init_state(jax.random.key(0))
@@ -160,20 +178,34 @@ def run_config(name, *, batch, dtype="bfloat16", unroll=1,
     t0 = time.time()
     server, clients, _ = trainer.run_rounds(server, clients,
                                             TIMED_ROUNDS)
-    jax.block_until_ready(server.params)
+    bench_sync(server.params)
     compile_s = time.time() - t0
 
-    if profile_dir:
-        os.makedirs(profile_dir, exist_ok=True)
-        jax.profiler.start_trace(profile_dir)
     t0 = time.time()
     server, clients, _ = trainer.run_rounds(server, clients,
                                             TIMED_ROUNDS)
-    jax.block_until_ready(server.params)
+    bench_sync(server.params)
     dt = time.time() - t0
+
     if profile_dir:
-        jax.profiler.stop_trace()
-        log(f"profiler trace captured to {profile_dir}")
+        # trace capture is a SEPARATE, untimed segment after the
+        # recorded one: capture_round_trace runs start_trace AND the
+        # trace serialization/disk write around its call, which would
+        # otherwise sit inside dt and deflate profiled rows against
+        # both their unprofiled siblings and the round-5 history.
+        # The hook drains the result inside the trace window with a
+        # 1-element fetch (utils/tracing.py) — the on-chip artifact
+        # the utilization round attributes the non-MXU time with.
+        # Absorbed on failure: a profiler quirk on the relay backend
+        # must never lose the config's already-measured timing row.
+        try:
+            server, clients, _ = capture_round_trace(
+                profile_dir, trainer.run_rounds, server, clients,
+                TIMED_ROUNDS)
+            log(f"profiler trace captured to {profile_dir}")
+        except Exception as e:
+            log(f"profiler capture failed ({str(e)[:160]}); "
+                "timing row kept")
 
     n_chips = int(trainer.mesh.devices.size)
     steps = TIMED_ROUNDS * trainer.k_online * trainer.local_steps
@@ -208,6 +240,7 @@ def run_config(name, *, batch, dtype="bfloat16", unroll=1,
     row = {
         "batch": batch, "dtype": dtype, "scan_unroll": unroll,
         "conv_impl": conv_impl, "arch": arch,
+        "client_fusion": trainer.client_fusion,
         "k_online": int(trainer.k_online),
         "local_steps_per_sec_per_chip": round(steps_per_sec, 2),
         "images_per_sec": round(steps_per_sec * batch, 1),
@@ -251,13 +284,33 @@ def main():
         return 1
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    profiling = os.environ.get("MFU_PROFILE") == "1"
     profile_dir = os.path.join(repo, "artifacts", "trace_northstar") \
-        if os.environ.get("MFU_PROFILE") == "1" else None
+        if profiling else None
+    profile_fused = os.path.join(repo, "artifacts",
+                                 "trace_northstar_fused") \
+        if profiling else None
 
     # ordered by information value: a mid-sweep relay wedge keeps the
     # most decisive configs (results persist incrementally)
     grid = [
         ("base", dict(batch=50, profile_dir=profile_dir)),
+        # round 6's utilization lever: the k online clients packed
+        # into ONE feature_group_count=k grouped conv per layer
+        # (k x the MXU output lanes on the 16-64-channel stages;
+        # docs/performance.md "Client-fused MXU execution"). Same
+        # algorithmic FLOPs as base — mfu_pct is directly comparable;
+        # the trace pair (base vs fused) attributes the non-MXU time.
+        # num_devices=1: the fusion gate rejects multi-device meshes
+        # (the packed client/channel axis must not be sharded), and a
+        # relay host exposing >1 chip would otherwise turn the whole
+        # fused A/B into error rows
+        ("fused", dict(batch=50, client_fusion="fused", num_devices=1,
+                       profile_dir=profile_fused)),
+        ("fused_online20", dict(batch=50, online_rate=0.2,
+                                client_fusion="fused", num_devices=1)),
+        ("fused128", dict(batch=128, client_fusion="fused",
+                          num_devices=1)),
         # im2col batched-matmul conv lowering (models/common.py) — the
         # model-level form of vmap_penalty_bench's conv_lowering A/B
         ("matmulconv", dict(batch=50, conv_impl="matmul")),

@@ -17,7 +17,11 @@
 #   flash-train      scripts/flash_train_bench.py -> FLASH_TRAIN.json
 #   flash-sweep      scripts/flash_block_sweep.py -> FLASH_BLOCK_SWEEP.json
 #   vmap             scripts/vmap_penalty_bench.py -> VMAP_PENALTY.json
-#   mfu              scripts/mfu_sweep.py         -> MFU_SWEEP.json
+#   mfu              MFU_PROFILE=1 scripts/mfu_sweep.py
+#                        -> MFU_SWEEP.json (now incl. the client-fused
+#                           configs) + artifacts/trace_northstar{,_fused}
+#                           on-chip profiler traces (the round-5 verdict
+#                           notes none has ever been captured)
 #   moe              scripts/moe_ab_bench.py      -> MOE_AB.json
 #   seqpar           scripts/seqpar_tpu_probe.py  -> SEQPAR_TPU_PROBE.json
 #   baseline         scripts/baseline_suite.py    -> BASELINE_SUITE.json
@@ -39,7 +43,10 @@ cd "$(dirname "$0")/.." || exit 1
 
 TRIES="${TPU_CAPTURE_WAIT_TRIES:-90}"   # ~6 h of patience by default
 
-DEFAULT_STEPS="bench-dispatch bench-unroll bench zoo pallas \
+# mfu leads: round 6 is the utilization round — the fused-vs-base A/B
+# and the first-ever on-chip traces are the highest-value capture if
+# the relay wedges mid-list
+DEFAULT_STEPS="mfu bench-dispatch bench-unroll bench zoo pallas \
 flash-train vmap baseline"
 STEPS="${*:-$DEFAULT_STEPS}"
 
@@ -63,7 +70,7 @@ for step in $STEPS; do
         flash-train)    run python scripts/flash_train_bench.py ;;
         flash-sweep)    run python scripts/flash_block_sweep.py ;;
         vmap)           run python scripts/vmap_penalty_bench.py ;;
-        mfu)            run python scripts/mfu_sweep.py ;;
+        mfu)            run env MFU_PROFILE=1 python scripts/mfu_sweep.py ;;
         moe)            run python scripts/moe_ab_bench.py ;;
         seqpar)         run python scripts/seqpar_tpu_probe.py ;;
         baseline)       run python scripts/baseline_suite.py ;;

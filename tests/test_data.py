@@ -187,3 +187,47 @@ def test_missing_dataset_clear_error(tmp_path):
     ).finalize()
     with pytest.raises(FileNotFoundError, match="no network egress"):
         build_federated_data(cfg)
+
+
+class TestEmnistMissingTestSplit:
+    """The EMNIST train-as-test fallback is opt-in (ISSUE 3
+    satellite): a missing test archive must raise, not silently score
+    training rows as the test set."""
+
+    def _write_train_h5(self, tmp_path, name="fed_emnist_digitsonly",
+                        sub="emnist"):
+        import h5py
+        base = tmp_path / sub
+        base.mkdir()
+        rng = np.random.RandomState(0)
+        with h5py.File(base / f"{name}_train.h5", "w") as f:
+            ex = f.create_group("examples")
+            for client in ("f0000_14", "f0001_41"):
+                g = ex.create_group(client)
+                g.create_dataset(
+                    "pixels", data=rng.rand(5, 28, 28).astype("f4"))
+                g.create_dataset("label", data=np.arange(5) % 10)
+
+    def test_missing_test_split_raises(self, tmp_path):
+        from fedtorch_tpu.data.datasets import load_emnist
+        self._write_train_h5(tmp_path)
+        with pytest.raises(FileNotFoundError,
+                           match="allow_train_as_test"):
+            load_emnist(str(tmp_path))
+
+    def test_opt_in_slices_train_with_warning(self, tmp_path):
+        from fedtorch_tpu.data.datasets import load_emnist
+        self._write_train_h5(tmp_path)
+        splits = load_emnist(str(tmp_path), allow_train_as_test=True)
+        assert splits.test_x.shape[0] == min(256,
+                                             splits.train_x.shape[0])
+        np.testing.assert_array_equal(
+            splits.test_x, splits.train_x[:splits.test_x.shape[0]])
+
+    def test_config_threads_the_opt_in(self):
+        cfg = ExperimentConfig(
+            data=DataConfig(dataset="emnist", allow_train_as_test=True),
+            federated=FederatedConfig(federated=True, num_clients=2),
+        ).finalize()
+        assert cfg.data.allow_train_as_test
+        assert not DataConfig().allow_train_as_test  # loud by default

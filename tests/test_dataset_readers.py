@@ -93,7 +93,10 @@ class TestTFFReaders:
                 g.create_dataset("pixels", data=np.random.rand(
                     n, 28, 28).astype(np.float32))
                 g.create_dataset("label", data=np.arange(n) % 10)
-        splits = load_emnist(str(tmp_path), full=False)
+        # train-only fixture: the missing test split now raises
+        # without the explicit opt-in (ISSUE 3)
+        splits = load_emnist(str(tmp_path), full=False,
+                             allow_train_as_test=True)
         assert splits.train_x.shape == (8, 28, 28, 1)
         assert len(splits.client_partitions) == 2
         assert [len(p) for p in splits.client_partitions] == [5, 3]
@@ -192,7 +195,8 @@ def test_get_dataset_dispatch_natural_partitions(tmp_path):
                              data=np.random.rand(4, 28, 28)
                              .astype(np.float32))
             g.create_dataset("label", data=np.arange(4) % 10)
-    cfg = DataConfig(dataset="emnist", data_dir=str(tmp_path))
+    cfg = DataConfig(dataset="emnist", data_dir=str(tmp_path),
+                     allow_train_as_test=True)  # train-only fixture
     splits = get_dataset(cfg, num_clients=3)
     assert len(splits.client_partitions) == 3
 

@@ -239,3 +239,50 @@ class TestTransformerIntegration:
                               rnn_hidden_size=8)).finalize()
         model = define_model(cfg, batch_size=2)
         assert model.module.attention == "flash"
+
+
+class TestAutoDispatch:
+    """Sequence-length dispatch guard (ISSUE 3 satellite): 'auto' must
+    keep the measured T=2048 regression window (FLASH_TRAIN.json read
+    flash at 0.68x dense there) off the flash kernel, and flip to
+    flash exactly where the on-chip A/B measured the win."""
+
+    def test_boundary(self):
+        from fedtorch_tpu.ops.attention_dispatch import (
+            FLASH_MIN_SEQ_LEN, resolve_attention,
+        )
+        assert resolve_attention("auto", 1024) == "dense"
+        assert resolve_attention("auto", 2048) == "dense"  # 0.68x case
+        assert resolve_attention("auto", FLASH_MIN_SEQ_LEN - 1) \
+            == "dense"
+        assert resolve_attention("auto", FLASH_MIN_SEQ_LEN) == "flash"
+        assert resolve_attention("auto", 8192) == "flash"
+
+    def test_explicit_modes_pass_through(self):
+        from fedtorch_tpu.ops.attention_dispatch import (
+            resolve_attention,
+        )
+        assert resolve_attention("dense", 8192) == "dense"
+        assert resolve_attention("flash", 128) == "flash"
+        with pytest.raises(ValueError, match="attention"):
+            resolve_attention("fast", 128)
+
+    def test_auto_is_the_config_default(self):
+        from fedtorch_tpu.config import ExperimentConfig, ModelConfig
+        assert ExperimentConfig().finalize().model.attention == "auto"
+        with pytest.raises(ValueError, match="attention"):
+            ExperimentConfig(
+                model=ModelConfig(attention="fast")).finalize()
+
+    def test_auto_equals_dense_below_threshold(self):
+        """At short T the 'auto' model must be the dense model
+        bit-for-bit (same params, same logits)."""
+        toks = jnp.arange(2 * 16, dtype=jnp.int32).reshape(2, 16) % 7
+        outs = {}
+        for mode in ("auto", "dense"):
+            m = TransformerLM(vocab_size=7, d_model=16, num_heads=2,
+                              num_layers=1, attention=mode)
+            params = m.init(jax.random.key(0), toks)["params"]
+            outs[mode] = m.apply({"params": params}, toks)
+        np.testing.assert_array_equal(np.asarray(outs["auto"]),
+                                      np.asarray(outs["dense"]))

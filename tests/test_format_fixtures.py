@@ -27,7 +27,10 @@ class TestTFFEmnist:
                    for i, n in zip(range(4), (7, 3, 5, 2))}
         p = tmp_path / "emnist" / "fed_emnist_digitsonly_train.h5"
         write_tff_emnist(str(p), clients, label_dtype=np.int32)
-        splits = load_emnist(str(tmp_path), full=False)
+        # the fixture writes only the train file; the missing test
+        # split now raises without the explicit opt-in (ISSUE 3)
+        splits = load_emnist(str(tmp_path), full=False,
+                             allow_train_as_test=True)
         assert splits.train_x.shape == (17, 28, 28, 1)
         # int32 labels (the real files' dtype) widen to int64
         assert splits.train_y.dtype == np.int64
@@ -50,7 +53,8 @@ class TestTFFEmnist:
         pytest.importorskip("h5py")
         p = tmp_path / "emnist_full" / "fed_emnist_train.h5"
         write_tff_emnist(str(p), {emnist_writer_id(0): 4})
-        splits = load_emnist(str(tmp_path), full=True)
+        splits = load_emnist(str(tmp_path), full=True,
+                             allow_train_as_test=True)
         assert splits.train_x.shape == (4, 28, 28, 1)
 
 

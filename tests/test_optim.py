@@ -131,3 +131,84 @@ def test_vmap_batch_of_optimizers():
     new_params, new_state = step(params, grads, state)
     np.testing.assert_allclose(np.asarray(new_params["w"]),
                                np.asarray(params["w"]) - 0.1, atol=1e-6)
+
+
+class TestWeightDecayExclusion:
+    """``wd_skip_norm_bias`` (ISSUE 3 satellite). Default OFF = the
+    reference's uniform decay over every parameter (sgd.py:96-101
+    decays the whole param group, BN scale/shift included) — parity
+    runs must keep that bias-but-faithful behavior. The opt-in applies
+    the standard exclusion: leaves named 'scale'/'bias' (the zoo's
+    norm affine pairs and layer biases) decay with coefficient 0."""
+
+    def params(self):
+        return {
+            "Conv_0": {"kernel": jnp.ones((2, 2)),
+                       "bias": jnp.ones((2,))},
+            "BatchStatsNorm_0": {"scale": jnp.ones((3,)),
+                                 "bias": jnp.ones((3,))},
+        }
+
+    def test_default_decays_uniformly(self):
+        cfg = OptimConfig(lr=1.0, weight_decay=0.1)
+        p = self.params()
+        grads = jax.tree.map(jnp.zeros_like, p)
+        new_p, _ = fopt.sgd_local_step(p, grads, fopt.init_sgd(p), 1.0,
+                                       cfg)
+        for leaf in jax.tree.leaves(new_p):
+            np.testing.assert_allclose(np.asarray(leaf), 0.9)
+
+    def test_opt_in_skips_norm_and_bias(self):
+        cfg = OptimConfig(lr=1.0, weight_decay=0.1,
+                          wd_skip_norm_bias=True)
+        p = self.params()
+        grads = jax.tree.map(jnp.zeros_like, p)
+        new_p, _ = fopt.sgd_local_step(p, grads, fopt.init_sgd(p), 1.0,
+                                       cfg)
+        np.testing.assert_allclose(np.asarray(new_p["Conv_0"]["kernel"]),
+                                   0.9)  # decayed
+        for leaf in (new_p["Conv_0"]["bias"],
+                     new_p["BatchStatsNorm_0"]["scale"],
+                     new_p["BatchStatsNorm_0"]["bias"]):
+            np.testing.assert_allclose(np.asarray(leaf), 1.0)  # skipped
+
+    @pytest.mark.parametrize("correct_wd", [False, True])
+    def test_adam_both_decay_forms_respect_exclusion(self, correct_wd):
+        cfg = OptimConfig(optimizer="adam", lr=0.1, weight_decay=0.1,
+                          correct_wd=correct_wd,
+                          wd_skip_norm_bias=True)
+        cfg0 = OptimConfig(optimizer="adam", lr=0.1, weight_decay=0.0,
+                           correct_wd=correct_wd)
+        p = self.params()
+        grads = jax.tree.map(jnp.zeros_like, p)
+        new_p, _ = fopt.adam_local_step(p, grads, fopt.init_adam(p),
+                                        0.1, cfg)
+        ref_p, _ = fopt.adam_local_step(p, grads, fopt.init_adam(p),
+                                        0.1, cfg0)
+        # skipped leaves behave exactly as with wd=0...
+        np.testing.assert_allclose(
+            np.asarray(new_p["BatchStatsNorm_0"]["scale"]),
+            np.asarray(ref_p["BatchStatsNorm_0"]["scale"]))
+        # ...while the kernel is decayed
+        assert not np.allclose(np.asarray(new_p["Conv_0"]["kernel"]),
+                               np.asarray(ref_p["Conv_0"]["kernel"]))
+
+    def test_exclusion_works_under_vmap_and_jit(self):
+        """The engine applies the optimizer inside jit (and under vmap
+        on the fused path); the path-based rule is static so it must
+        trace cleanly."""
+        cfg = OptimConfig(lr=0.5, weight_decay=0.2,
+                          wd_skip_norm_bias=True)
+        C = 3
+        p = {"Dense_0": {"kernel": jnp.ones((C, 2)),
+                         "bias": jnp.ones((C,))}}
+        grads = jax.tree.map(jnp.zeros_like, p)
+        state = fopt.init_sgd(p)
+        step = jax.jit(jax.vmap(
+            lambda pp, gg, ss: fopt.sgd_local_step(pp, gg, ss, 0.5,
+                                                   cfg)))
+        new_p, _ = step(p, grads, state)
+        np.testing.assert_allclose(np.asarray(new_p["Dense_0"]["kernel"]),
+                                   1.0 - 0.5 * 0.2)
+        np.testing.assert_allclose(np.asarray(new_p["Dense_0"]["bias"]),
+                                   1.0)
