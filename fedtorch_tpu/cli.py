@@ -181,8 +181,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--resume", default=None)
     p.add_argument("--checkpoint_index", default=None)
     p.add_argument("-c", "--checkpoint", default="./checkpoint/")
+    p.add_argument("--run_dir", default=None,
+                   help="use this exact directory for checkpoints/logs "
+                        "instead of a hyperparam+timestamp subfolder of "
+                        "--checkpoint; required for elastic restarts "
+                        "(run_elastic/supervise relaunch with "
+                        "--resume <this dir>)")
     p.add_argument("--save_all_models", type=str2bool, default=False)
     p.add_argument("--save_some_models", default="1,29,59")
+    p.add_argument("--checkpoint_keep_last_n", type=int, default=0,
+                   help="garbage-collect all but the newest N per-round "
+                        "checkpoint_r{N}.ckpt keeps (0 = keep all; "
+                        "model_best/checkpoint.ckpt never collected)")
     p.add_argument("--async_checkpoint", action="store_true",
                    help="write checkpoints from a background thread "
                         "(atomic) so rounds never block on disk")
@@ -224,6 +234,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "the running loss EMA counts as divergence")
     p.add_argument("--supervisor_max_retries", type=int, default=2)
     p.add_argument("--supervisor_backoff_base", type=float, default=0.5)
+    p.add_argument("--watchdog_timeout_s", type=float, default=0.0,
+                   help=">0 arms the stall watchdog: if no round "
+                        "completes within this many seconds (a dead "
+                        "peer blocking a DCN collective), dump thread "
+                        "stacks to the run log and exit with the "
+                        "restartable code 75 so the restart harness "
+                        "cycles the job (docs/robustness.md)")
     # device / mesh (replaces parameters.py:225-236 MPI block)
     p.add_argument("--backend", default=None,
                    help="jax platform: tpu|cpu|None(auto)")
@@ -352,10 +369,12 @@ def args_to_config(args) -> ExperimentConfig:
             summary_freq=args.summary_freq,
             per_class_acc=args.per_class_acc),
         checkpoint=CheckpointConfig(
-            checkpoint_dir=args.checkpoint, resume=args.resume,
+            checkpoint_dir=args.checkpoint, run_dir=args.run_dir,
+            resume=args.resume,
             checkpoint_index=args.checkpoint_index,
             save_all_models=args.save_all_models,
             save_some_models=args.save_some_models,
+            keep_last_n=args.checkpoint_keep_last_n,
             async_save=args.async_checkpoint,
             log_dir=args.log_dir, debug=args.debug,
             check_model_at_sync=args.check_model_at_sync,
@@ -378,20 +397,45 @@ def args_to_config(args) -> ExperimentConfig:
             supervisor=args.supervisor,
             loss_blowup_factor=args.supervisor_loss_blowup,
             max_retries=args.supervisor_max_retries,
-            backoff_base_s=args.supervisor_backoff_base),
+            backoff_base_s=args.supervisor_backoff_base,
+            watchdog_timeout_s=args.watchdog_timeout_s),
         experiment=args.experiment,
     )
     return cfg.finalize()
 
 
 def run_experiment(cfg: ExperimentConfig,
-                   download: bool = False) -> dict:
-    """The driver loop (main.py dispatch + federated/main.py:56-211)."""
+                   download: bool = False,
+                   round_callback=None) -> dict:
+    """The driver loop (main.py dispatch + federated/main.py:56-211).
+
+    ``round_callback(r, trainer, server, clients, metrics)`` (optional)
+    fires after every completed federated round — the hook the
+    preemption/kill-drill harness uses to fingerprint rounds.
+
+    Process lifecycle (docs/robustness.md "Process lifecycle"):
+    SIGTERM/SIGINT/SIGUSR1 request a drain — the loop finishes the
+    round in flight, agrees on the stop across hosts, writes a final
+    checkpoint, flushes the async writer, and the result carries
+    ``preempted=True`` (:func:`main` converts that into the restartable
+    exit code 75). ``fault.watchdog_timeout_s > 0`` additionally arms a
+    stall watchdog that converts a wedged pod into the same exit code.
+    """
     import jax
     import jax.numpy as jnp
 
     from fedtorch_tpu.utils import enable_compile_cache
-    enable_compile_cache()
+    if cfg.checkpoint.resume is None:
+        enable_compile_cache()
+    # else: resumed runs bypass the persistent compilation cache. On
+    # cpu jaxlib 0.4.36, executing the CACHE-DESERIALIZED round
+    # executable on restored (post-``maybe_resume``) state corrupts
+    # the donated output buffers — bitwise-correct losses but garbage
+    # aggregated params on the first post-resume round, then a heap-
+    # corruption abort at exit; ~50% reproducible in the kill drill
+    # (tests/test_kill_drill.py), 0% with the cache bypassed. A
+    # restarted run recompiles (seconds on CPU, ~40-50s on TPU) —
+    # correctness over restart latency until the jaxlib bug is fixed.
 
     from fedtorch_tpu.algorithms import make_algorithm
     from fedtorch_tpu.data import build_federated_data
@@ -457,6 +501,8 @@ def run_experiment(cfg: ExperimentConfig,
     if cfg.checkpoint.async_save:
         from fedtorch_tpu.utils import AsyncCheckpointer
         async_ckpt = AsyncCheckpointer()
+    saver = async_ckpt.save if async_ckpt is not None else save_checkpoint
+    last_saved_round = None
     supervisor = None
     run_round = trainer.run_round
     if cfg.fault.supervisor:
@@ -464,6 +510,19 @@ def run_experiment(cfg: ExperimentConfig,
         supervisor = RoundSupervisor(trainer, checkpoint_dir=ckpt_dir,
                                      logger=logger)
         run_round = supervisor.run_round
+    # process lifecycle: signal-driven drain + stall watchdog
+    # (robustness/preemption.py, robustness/watchdog.py). The stop
+    # decision is SPMD-agreed via the per-round scalar fetch; the
+    # watchdog is host-only and off by default (watchdog_timeout_s=0).
+    from fedtorch_tpu.robustness import PreemptionHandler, StallWatchdog
+    preempt = PreemptionHandler(logger=logger)
+    preempt.install()
+    trainer.attach_stop_signal(lambda: preempt.stop_requested)
+    # NOTE for operators: the timeout must comfortably exceed the
+    # worst-case compile + round + eval + checkpoint time — the first
+    # round pays XLA compilation under the same clock.
+    watchdog = StallWatchdog(cfg.fault.watchdog_timeout_s, logger=logger)
+    watchdog.start()
     results = {}
     start_round = int(jax.device_get(server.round))
     loop_raised = False
@@ -490,6 +549,9 @@ def run_experiment(cfg: ExperimentConfig,
             else:
                 sc = trainer.round_host_scalars(clients, metrics)
             timer.add_comm(num_bytes=sc["comm_bytes"])
+            # the scalar fetch blocked on the round's results: the
+            # round genuinely completed — feed the stall watchdog
+            watchdog.heartbeat(r)
 
             if cfg.fault.chaos_enabled or cfg.fault.guard_updates:
                 if sc["dropped"] or sc["rejected"] or sc["clipped"] \
@@ -541,11 +603,10 @@ def run_experiment(cfg: ExperimentConfig,
                     logger.log("Round: {}. Per-class acc: {}".format(
                         r, [round(float(a), 4) for a in accs]))
                 timer.start("checkpoint")
-                saver = async_ckpt.save if async_ckpt is not None \
-                    else save_checkpoint
                 saver(ckpt_dir, server, clients, cfg, best_prec1,
                       is_best, save_all=cfg.checkpoint.save_all_models,
                       save_some_rounds=save_rounds)
+                last_saved_round = r
                 timer.stop("checkpoint")
                 if cfg.federated.personal and fed_data.val is not None \
                         and cfg.effective_algorithm in (
@@ -557,10 +618,41 @@ def run_experiment(cfg: ExperimentConfig,
                                    summary["loss_mean"],
                                    summary["acc_mean"])
                 results["test_top1"] = top1
+            if round_callback is not None:
+                round_callback(r, trainer, server, clients, metrics)
+            if sc.get("stop"):
+                # SPMD-agreed stop (every process computed the same
+                # cross-host max): drain at the round boundary — write
+                # a final checkpoint and leave with the restartable
+                # exit code instead of dying mid-state. The watchdog
+                # must disarm FIRST: a slow final write would read as
+                # a stall and os._exit would lose the drain.
+                watchdog.stop()
+                logger.log(f"preemption: stop requested "
+                           f"({preempt.reason or 'peer host'}); "
+                           f"draining after round {r}")
+                if last_saved_round != r:
+                    # skip when this round's eval branch already wrote
+                    # the same state — the snapshot is a collective on
+                    # pods and a preemption deadline is ticking
+                    timer.start("checkpoint")
+                    saver(ckpt_dir, server, clients, cfg, best_prec1,
+                          False,
+                          save_all=cfg.checkpoint.save_all_models,
+                          save_some_rounds=save_rounds)
+                    timer.stop("checkpoint")
+                results["preempted"] = True
+                results["preempted_at_round"] = r
+                break
     except BaseException:
         loop_raised = True
         raise
     finally:
+        # the drain itself must not race the watchdog (a slow final
+        # write would read as a stall), and the handlers must never
+        # outlive the loop in library callers
+        watchdog.stop()
+        preempt.restore()
         if async_ckpt is not None:
             # flush pending writes even when the loop raised — the
             # checkpoint the user would resume from must hit disk. A
@@ -594,6 +686,11 @@ def run_experiment(cfg: ExperimentConfig,
                        "skipped round(s)")
     results["timer"] = timer.summary()
     logger.log(f"phase timers: {timer.summary()}")
+    if results.get("preempted"):
+        from fedtorch_tpu.robustness import RESTART_EXIT_CODE
+        logger.log("preemption: final checkpoint drained and flushed; "
+                   f"restartable exit (code {RESTART_EXIT_CODE}) — "
+                   "run_elastic/supervise will relaunch with --resume")
     return results
 
 
@@ -607,10 +704,25 @@ def main(argv=None):
         # initializes jax
         from fedtorch_tpu.lint.cli import main as lint_main
         return lint_main(argv[1:])
+    if argv and argv[0] == "supervise":
+        # `fedtorch-tpu supervise [opts] -- <training command>` — the
+        # per-host auto-restart harness (robustness/harness.py):
+        # relaunches the command with --resume on restartable exits
+        from fedtorch_tpu.robustness.harness import main as harness_main
+        return harness_main(argv[1:])
     args = build_parser().parse_args(argv)
     cfg = args_to_config(args)
-    return run_experiment(cfg, download=args.download)
+    results = run_experiment(cfg, download=args.download)
+    if isinstance(results, dict) and results.get("preempted"):
+        # EX_TEMPFAIL: the restart-harness contract — raised (not
+        # returned) so `python -m fedtorch_tpu.cli` and the console
+        # script both exit 75
+        from fedtorch_tpu.robustness import RESTART_EXIT_CODE
+        raise SystemExit(RESTART_EXIT_CODE)
+    return results
 
 
 if __name__ == "__main__":
-    main()
+    _result = main()
+    if isinstance(_result, int):  # lint / supervise exit codes
+        raise SystemExit(_result)

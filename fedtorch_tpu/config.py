@@ -245,10 +245,20 @@ class TrainConfig:
 class CheckpointConfig:
     """Checkpoint/resume (ref: parameters.py:204-222)."""
     checkpoint_dir: str = "./checkpoint/"
+    # exact run directory (no hyperparam/timestamp subfolders). A
+    # restarted process must FIND the previous attempt's checkpoint, so
+    # elastic runs (robustness/harness.py) pin this to a stable path
+    # and pass the same path as the harness's --ckpt_dir.
+    run_dir: Optional[str] = None
     resume: Optional[str] = None
     checkpoint_index: Optional[str] = None
     save_all_models: bool = False
     save_some_models: str = "1,29,59"
+    # bounded retention for the per-round checkpoint_r{N}.ckpt keeps:
+    # > 0 garbage-collects all but the newest N after each write; 0
+    # (default) keeps everything — save_all_models' historical
+    # semantics. model_best.* and checkpoint.ckpt are never collected.
+    keep_last_n: int = 0
     # write checkpoints from a background thread (atomic tmp+rename)
     # so training dispatch never blocks on serialization/disk
     async_save: bool = False
@@ -313,6 +323,14 @@ class FaultConfig:
     # retried round draws a fresh participation/chaos schedule (an
     # unchanged deterministic program would reproduce the failure)
     reseed_on_retry: bool = True
+    # -- process lifecycle (robustness/preemption.py, watchdog.py) -----
+    # > 0 arms the stall watchdog: when no round completes within this
+    # many seconds (the signature of a dead peer blocking a DCN
+    # collective), thread stacks are dumped to the run log and the
+    # process hard-exits with the restartable code 75 so the restart
+    # harness cycles it. 0 (default) = off: no monitor thread, and the
+    # traced round program is byte-identical (host-only feature).
+    watchdog_timeout_s: float = 0.0
 
     @property
     def chaos_enabled(self) -> bool:
@@ -486,6 +504,14 @@ class ExperimentConfig:
         if flt.max_retries < 0:
             raise ValueError(
                 f"fault.max_retries must be >= 0, got {flt.max_retries}")
+        if flt.watchdog_timeout_s < 0.0:
+            raise ValueError(
+                "fault.watchdog_timeout_s must be >= 0 (0 = off), got "
+                f"{flt.watchdog_timeout_s}")
+        if self.checkpoint.keep_last_n < 0:
+            raise ValueError(
+                "checkpoint.keep_last_n must be >= 0 (0 = unlimited), "
+                f"got {self.checkpoint.keep_last_n}")
 
         return dataclasses.replace(
             self, data=data, federated=fed, train=train, optim=optim)

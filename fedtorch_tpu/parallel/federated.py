@@ -40,10 +40,11 @@ Design (SURVEY.md §7):
 from __future__ import annotations
 
 import math
-from typing import Optional, Tuple
+from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from fedtorch_tpu.algorithms.base import (FedAlgorithm, num_online_effective)
 from fedtorch_tpu.config import ExperimentConfig
@@ -63,7 +64,8 @@ from fedtorch_tpu.models.common import ModelDef
 from fedtorch_tpu.ops.augment import augment_image_batch
 from fedtorch_tpu.parallel.fusion import resolve_client_fusion
 from fedtorch_tpu.parallel.mesh import (
-    make_mesh, padded_client_count, replicate, shard_clients,
+    client_sharding, make_mesh, padded_client_count, replicate,
+    replicated_sharding, shard_clients,
 )
 from fedtorch_tpu.robustness.chaos import (
     draw_chaos_plan, no_chaos_plan, poison_tree,
@@ -191,6 +193,11 @@ class FederatedTrainer:
             instrument_trace(self.trace_name, self.round_fn),
             donate_argnums=(0, 1))
         self._rounds_jit: dict = {}  # num_rounds -> jitted scan driver
+        # preemption stop-flag plumbing (robustness/preemption.py):
+        # attach_stop_signal folds a cross-host-agreed stop flag into
+        # round_scalars_dev; nothing here touches the round program
+        self._stop_signal: Optional[Callable[[], bool]] = None
+        self._stop_reduce = None  # lazily-jitted cross-process max
 
     # -- state ----------------------------------------------------------
     def init_state(self, rng: jax.Array) -> Tuple[ServerState, ClientState]:
@@ -709,12 +716,44 @@ class FederatedTrainer:
     def mean_client_epoch(self, clients) -> float:
         return float(jax.device_get(self._mean_epoch_dev(clients)))
 
+    # -- preemption stop flag (robustness/preemption.py) ------------------
+    def attach_stop_signal(self, fn: Callable[[], bool]) -> None:
+        """Register a zero-arg host callable (e.g.
+        ``PreemptionHandler.stop_requested``) polled once per round.
+        Its value is folded into :meth:`round_scalars_dev` as the
+        ``"stop"`` entry — on multi-host meshes as a cross-process max
+        reduction, so every process agrees on the stop round (a host
+        that exits while its peers enter the next round's collective
+        would wedge the pod). Riding the existing per-round scalar
+        fetch means the agreement costs no extra transfer."""
+        self._stop_signal = fn
+
+    def stop_flag_dev(self, local_stop: bool) -> jnp.ndarray:
+        """Device scalar = max of ``local_stop`` over all processes
+        (1.0 if ANY host wants to stop). Single-process meshes skip
+        the collective entirely."""
+        flag = np.float32(1.0 if local_stop else 0.0)
+        if jax.process_count() == 1:
+            return jnp.asarray(flag)
+        sh = client_sharding(self.mesh)
+        n = int(self.mesh.devices.size)
+        local_rows = sum(1 for d in self.mesh.devices.flat
+                         if d.process_index == jax.process_index())
+        arr = jax.make_array_from_process_local_data(
+            sh, np.full((local_rows,), flag, np.float32), (n,))
+        if self._stop_reduce is None:
+            self._stop_reduce = jax.jit(
+                jnp.max, out_shardings=replicated_sharding(self.mesh))
+        return self._stop_reduce(arr)
+
     def round_scalars_dev(self, clients, metrics) -> dict:
         """DEVICE-side dict of everything the host round loop logs —
         no transfer here, so callers (the CLI loop, the round
-        supervisor) can extend it and pay ONE ``device_get`` total."""
+        supervisor) can extend it and pay ONE ``device_get`` total.
+        With a stop signal attached (:meth:`attach_stop_signal`) the
+        dict also carries the SPMD-agreed ``"stop"`` flag."""
         mean_epoch = self._mean_epoch_dev(clients)
-        return {
+        out = {
             "mean_epoch": mean_epoch,
             # the logged LR is a jnp computation over the schedule
             # arrays — evaluate it on device and ride the same fetch
@@ -728,6 +767,9 @@ class FederatedTrainer:
             "rejected": metrics.rejected_updates,
             "clipped": metrics.clipped_updates,
         }
+        if self._stop_signal is not None:
+            out["stop"] = self.stop_flag_dev(bool(self._stop_signal()))
+        return out
 
     def round_host_scalars(self, clients, metrics) -> dict:
         """Everything the host round loop logs, fetched in ONE batched

@@ -42,6 +42,21 @@ def init_multihost(cfg: MeshConfig, *,
     every host in the pod. ``_sleep`` is injectable for tests."""
     if cfg.coordinator_address is None:
         return
+    # Multi-process CPU (the virtual-pod substrate every multihost test
+    # runs on) needs an explicit cross-process collectives backend:
+    # without one, the first sharded computation dies with
+    # "Multiprocess computations aren't implemented on the CPU
+    # backend". Gloo ships in jaxlib; set it only when the platform is
+    # pinned to cpu (reading the config flag does NOT initialize a
+    # backend — calling jax.default_backend() here would, breaking
+    # distributed.initialize's must-run-first contract).
+    platforms = (getattr(jax.config, "jax_platforms", None) or "").lower()
+    if "cpu" in platforms.split(","):
+        try:
+            jax.config.update(
+                "jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # jax version without the knob
+            pass
     timeout_s = cfg.init_timeout_s if timeout_s is None else timeout_s
     backoff_s = cfg.init_backoff_s if backoff_s is None else backoff_s
     deadline = time.monotonic() + timeout_s

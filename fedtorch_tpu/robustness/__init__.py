@@ -4,6 +4,15 @@ from fedtorch_tpu.robustness.chaos import (  # noqa: F401
 from fedtorch_tpu.robustness.guards import (  # noqa: F401
     GuardReport, screen_payloads,
 )
+from fedtorch_tpu.robustness.harness import (  # noqa: F401
+    ElasticRunner, read_checkpoint_round,
+)
+from fedtorch_tpu.robustness.preemption import (  # noqa: F401
+    RESTART_EXIT_CODE, PreemptionHandler,
+)
 from fedtorch_tpu.robustness.supervisor import (  # noqa: F401
     RoundSupervisor, SupervisorStats,
+)
+from fedtorch_tpu.robustness.watchdog import (  # noqa: F401
+    StallWatchdog, format_thread_stacks,
 )

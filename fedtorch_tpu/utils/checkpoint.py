@@ -16,10 +16,12 @@ and round counter — is serialized, so a resumed run continues exactly.
 """
 from __future__ import annotations
 
+import atexit
 import dataclasses
 import hashlib
 import json
 import os
+import re
 import time
 import warnings
 
@@ -52,6 +54,14 @@ def get_checkpoint_folder_name(cfg: ExperimentConfig) -> str:
 
 
 def init_checkpoint_dir(cfg: ExperimentConfig) -> str:
+    """Run directory. ``checkpoint.run_dir`` (when set) is used EXACTLY
+    — no hyperparam/timestamp subfolders — because an elastically
+    restarted process must land in the same directory as the attempt
+    it is resuming (robustness/harness.py relaunches with
+    ``--resume <this dir>``)."""
+    if cfg.checkpoint.run_dir:
+        os.makedirs(cfg.checkpoint.run_dir, exist_ok=True)
+        return cfg.checkpoint.run_dir
     root = os.path.join(cfg.checkpoint.checkpoint_dir, cfg.data.dataset,
                         cfg.model.arch, get_checkpoint_folder_name(cfg))
     os.makedirs(root, exist_ok=True)
@@ -169,10 +179,43 @@ def _atomic_write(path: str, data: bytes) -> None:
     os.replace(tmp, path)
 
 
+_ROUND_KEEP_RE = re.compile(r"^checkpoint_r(\d+)\.ckpt$")
+
+
+def collect_round_keeps(directory: str, keep_last_n: int) -> list:
+    """Bounded retention for the per-round ``checkpoint_r{N}.ckpt``
+    keeps: delete all but the newest ``keep_last_n`` (by round number).
+    ``keep_last_n <= 0`` keeps everything (``save_all_models``'
+    historical semantics); ``checkpoint.ckpt`` / ``model_best.*`` are
+    never candidates. Returns the removed paths."""
+    if keep_last_n <= 0:
+        return []
+    keeps = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    for name in names:
+        m = _ROUND_KEEP_RE.match(name)
+        if m:
+            keeps.append((int(m.group(1)), name))
+    keeps.sort()
+    removed = []
+    for _, name in keeps[:max(len(keeps) - keep_last_n, 0)]:
+        path = os.path.join(directory, name)
+        try:
+            os.remove(path)
+            removed.append(path)
+        except OSError:  # raced with an external cleaner — fine
+            pass
+    return removed
+
+
 def _write_checkpoint(directory: str, host_state, meta: dict,
                       is_best: bool, round_idx: int,
                       save_all: bool,
-                      save_some_rounds: Tuple[int, ...]) -> str:
+                      save_some_rounds: Tuple[int, ...],
+                      keep_last_n: int = 0) -> str:
     """Serialize + write an already-host-resident snapshot (the worker
     half of both the sync and async paths)."""
     os.makedirs(directory, exist_ok=True)
@@ -192,6 +235,7 @@ def _write_checkpoint(directory: str, host_state, meta: dict,
         _atomic_write(
             os.path.join(directory, f"checkpoint_r{round_idx}.ckpt"),
             payload)
+        collect_round_keeps(directory, keep_last_n)
     return path
 
 
@@ -232,7 +276,7 @@ def save_checkpoint(directory: str, server, clients,
     return _write_checkpoint(
         directory, host_state,
         _meta_for(cfg, round_idx, best_prec1), is_best, round_idx,
-        save_all, save_some_rounds)
+        save_all, save_some_rounds, cfg.checkpoint.keep_last_n)
 
 
 class AsyncCheckpointer:
@@ -248,15 +292,22 @@ class AsyncCheckpointer:
     durably written — latest-wins dropping would silently lose 'best'
     copies.
 
-    Call :meth:`wait` before reading checkpoints back or at run end."""
+    Call :meth:`wait` before reading checkpoints back or at run end.
+    :meth:`close` is idempotent, runs on interpreter exit as an
+    ``atexit`` fallback (a code path that never reaches the CLI's
+    try/finally — e.g. a library caller's own crash — must still land
+    the queued checkpoint instead of silently dropping it with the
+    daemon worker thread), and unregisters itself once closed."""
 
     def __init__(self):
         import queue
         import threading
         self._q: "queue.Queue" = queue.Queue(maxsize=1)
         self._errors: list = []
+        self._closed = False
         self._thread = threading.Thread(target=self._worker, daemon=True)
         self._thread.start()
+        atexit.register(self._atexit_close)
 
     def _worker(self):
         while True:
@@ -292,7 +343,8 @@ class AsyncCheckpointer:
         round_idx = int(server.round)
         self._q.put((directory, host_state,
                      _meta_for(cfg, round_idx, best_prec1), is_best,
-                     round_idx, save_all, save_some_rounds))
+                     round_idx, save_all, save_some_rounds,
+                     cfg.checkpoint.keep_last_n))
 
     def wait(self) -> None:
         """Block until every enqueued checkpoint is on disk."""
@@ -300,6 +352,15 @@ class AsyncCheckpointer:
         self._raise_pending()
 
     def close(self) -> None:
+        """Drain pending writes and stop the worker. Idempotent: the
+        CLI's finally block, a library caller, and the atexit fallback
+        may all call it — only the first does the work (a second
+        ``_q.put(None)`` after the worker exited would block forever
+        on the size-1 queue)."""
+        if self._closed:
+            return
+        self._closed = True
+        atexit.unregister(self._atexit_close)
         try:
             self.wait()
         finally:
@@ -307,6 +368,16 @@ class AsyncCheckpointer:
             # error — library users must not leak the thread
             self._q.put(None)
             self._thread.join(timeout=30)
+
+    def _atexit_close(self) -> None:
+        """Interpreter-exit fallback: land the queued checkpoint, but
+        never let a flush error mask the exit in progress."""
+        try:
+            self.close()
+        except Exception as e:
+            import sys
+            print(f"AsyncCheckpointer: atexit flush failed: {e!r}",
+                  file=sys.stderr, flush=True)
 
 
 def _corrupt_skip(path: str, why: str, server, clients):
@@ -382,9 +453,25 @@ def maybe_resume(directory: Optional[str], server, clients,
     except Exception as e:  # msgpack/flax raise various concrete types
         return _corrupt_skip(path, f"deserialization failed: {e}",
                              server, clients)
+    # from_bytes hands back numpy arrays that can be zero-copy VIEWS
+    # into ``payload``; own them before anything else touches them
+    restored = jax.tree.map(_owning_host_copy, restored)
     # graft the restored real clients back into the (possibly padded)
     # freshly-initialized template, preserving its sharding layout
     new_clients = jax.tree.map(lambda full, real: full.at[:C].set(real),
                                clients, restored["clients"])
-    return (_rekey(restored["server"]), new_clients,
+    # The returned state feeds straight into the round jit, which
+    # DONATES its inputs. Host-numpy leaves must not meet donation:
+    # the jit's implicit numpy->Array conversion has been observed (cpu
+    # jaxlib 0.4.36) to hand XLA buffers whose backing memory is torn
+    # down with the host array — the first post-resume round then
+    # aggregates into recycled heap (bitwise-correct losses, garbage
+    # server params, a heap-corruption abort at exit). Committing the
+    # restored server to device arrays HERE makes resume hand back
+    # exactly what init_state does — jax-owned, donation-safe buffers.
+    server = jax.tree.map(
+        lambda x: jax.device_put(x) if not isinstance(x, jax.Array)
+        else x, _rekey(restored["server"]))
+    jax.block_until_ready(server)
+    return (server, new_clients,
             float(meta.get("best_prec1", 0.0)), True)

@@ -17,6 +17,7 @@ one-program-per-round design:
 """
 from __future__ import annotations
 
+import os
 from typing import Dict
 
 import jax
@@ -67,3 +68,36 @@ def check_finite(params) -> bool:
     reference's norm prints served). Host-side convenience wrapper over
     :func:`model_norms`' fused device check."""
     return bool(model_norms(params)["all_finite"])
+
+
+def runtime_snapshot() -> Dict[str, object]:
+    """Host-side process state for stall post-mortems — what the
+    watchdog dumps when no round completes (robustness/watchdog.py).
+
+    Deliberately touches NO device state: on a wedged pod any device
+    interaction (even a norm check) would block behind the stuck
+    collective, so this reads only interpreter/OS facts. Every probe
+    is individually guarded — a half-dead runtime must still produce
+    a partial report."""
+    import threading
+
+    snap: Dict[str, object] = {"pid": os.getpid()}
+    try:
+        snap["threads"] = sorted(t.name for t in threading.enumerate())
+    except Exception:
+        pass
+    try:
+        import resource
+        snap["max_rss_kb"] = resource.getrusage(
+            resource.RUSAGE_SELF).ru_maxrss
+    except Exception:
+        pass
+    try:
+        # already-initialized backend facts only: jax.devices() is
+        # cached after bring-up and process_index is a local field —
+        # neither dispatches device work
+        snap["process"] = f"{jax.process_index()}/{jax.process_count()}"
+        snap["local_devices"] = jax.local_device_count()
+    except Exception:
+        pass
+    return snap

@@ -1,13 +1,10 @@
-# Shared helpers for the round-5 capture chain. Source, don't execute.
+# Shared helpers for the capture entry points. Source, don't execute.
 #
 # Stage ordering uses DONE-SENTINEL files, not pgrep: a pgrep poll
 # reads "predecessor not started yet" as "finished" and would let two
 # stages probe the single-session relay concurrently (the documented
-# wedge trigger). Each stage traps EXIT to touch its sentinel; the
-# launcher removes stale sentinels before starting a fresh chain.
-
-R5_DONE=/tmp/tpu_capture_r5.done
-R5B_DONE=/tmp/tpu_capture_r5b.done
+# wedge trigger). A chained stage traps EXIT to touch its sentinel;
+# the launcher removes stale sentinels before starting a fresh chain.
 
 wait_for_done() {
     while [ ! -f "$1" ]; do
@@ -38,9 +35,9 @@ EOF
 }
 
 # Lowering-A/B variant stage. The function names predate the round-5
-# default flip (they are called by name from tpu_capture_r5.sh /
-# _r5c.sh, which were running when the flip landed and cannot be
-# edited in place): post-flip the shipped default 'auto' resolves to
+# default flip (the deleted r5/r5c stage chains called them by name
+# while running when the flip landed): post-flip the shipped default
+# 'auto' resolves to
 # native conv on TPU, so the VARIANT side of the on-chip A/B is now
 # the im2col matmul lowering -> BENCH_MATMULSIDE_AB.json. The round-5
 # first-window pair was captured under the pre-flip default (default

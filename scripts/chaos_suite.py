@@ -124,6 +124,75 @@ def run_suite(rounds: int = 20, smoke: bool = False, tol_points: float = 5.0,
     return report
 
 
+def run_kill_drill(rounds: int = 150, ckpt_root: str = None) -> dict:
+    """Process-lifecycle chaos (ISSUE 4): SIGTERM the REAL CLI mid-run,
+    assert it drains and exits 75, then let the ElasticRunner harness
+    relaunch it with --resume and finish the job. The bitwise
+    trajectory-identity half of this drill lives in
+    tests/test_kill_drill.py; this entry checks the operator-facing
+    lifecycle end to end (drain -> restartable exit -> relaunch ->
+    completion) against the production entry point."""
+    import signal
+    import subprocess
+    import tempfile
+    import threading
+
+    from fedtorch_tpu.robustness.harness import (
+        ElasticRunner, read_checkpoint_round,
+    )
+
+    run_dir = os.path.join(ckpt_root or tempfile.mkdtemp(), "run")
+    cmd = [sys.executable, "-m", "fedtorch_tpu.cli",
+           "--federated", "true", "-d", "synthetic", "-a",
+           "logistic_regression", "--num_comms", str(rounds),
+           "--num_workers", "8", "--online_client_rate", "0.5",
+           "--federated_sync_type", "local_step", "--local_step", "2",
+           "--batch_size", "8", "--lr", "0.1", "--eval_freq", "1",
+           "--debug", "false", "--run_dir", run_dir]
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    state = {"killed": False}
+
+    def popen(c, **kw):
+        proc = subprocess.Popen(c, env=env, stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+        if not state["killed"]:
+            # watch checkpoint.json; SIGTERM once the run is mid-flight
+            def killer():
+                while proc.poll() is None:
+                    r = read_checkpoint_round(run_dir)
+                    if r is not None and r >= 3:
+                        state["killed"] = True
+                        try:
+                            proc.send_signal(signal.SIGTERM)
+                        except OSError:  # raced to exit
+                            pass
+                        return
+                    time.sleep(0.02)
+
+            threading.Thread(target=killer, daemon=True).start()
+        return proc
+
+    runner = ElasticRunner(cmd, ckpt_dir=run_dir, max_restarts=3,
+                           backoff_base_s=0.1, popen=popen, log_fn=log)
+    t0 = time.time()
+    rc = runner.run()
+    final_round = read_checkpoint_round(run_dir)
+    assert state["killed"], \
+        "kill drill never landed its SIGTERM (job finished too fast — " \
+        "raise rounds)"
+    assert rc == 0, f"relaunched job did not complete cleanly (rc={rc})"
+    assert runner.launches >= 2, \
+        "child was killed but the harness never relaunched it"
+    assert final_round == rounds, \
+        f"resumed job stopped at round {final_round}, wanted {rounds}"
+    report = {"rounds": rounds, "launches": runner.launches,
+              "final_round": final_round,
+              "wall_seconds": round(time.time() - t0, 1)}
+    log(f"kill drill: {report}")
+    return report
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rounds", type=int, default=20)
@@ -132,9 +201,15 @@ def main():
     ap.add_argument("--tol", type=float, default=5.0,
                     help="max accuracy-point gap vs the fault-free run")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--kill-drill", action="store_true",
+                    help="also run the process-lifecycle kill drill "
+                         "(SIGTERM -> exit 75 -> relaunch -> complete)")
     args = ap.parse_args()
     report = run_suite(rounds=args.rounds, smoke=args.smoke,
                        tol_points=args.tol, seed=args.seed)
+    if args.kill_drill:
+        report["kill_drill"] = run_kill_drill(
+            rounds=60 if args.smoke else 150)
     print(json.dumps(report), flush=True)
 
 

@@ -18,9 +18,9 @@ absolute times say nothing about the v5e, and the conv-vs-matmul
 ratio can differ on the chip where the MXU executes large matmuls at
 full rate (the reason the matmul lowering should win HARDER there —
 the roofline argument in docs/performance.md "MFU roofline"). The
-on-chip sweep (queued in scripts/tpu_capture_r5.sh) remains the
-decision authority; this table is the best evidence obtainable without
-the relay.
+on-chip sweep (`scripts/tpu_capture.sh conv-ab`) remains the decision
+authority; this table is the best evidence obtainable without the
+relay.
 
 Writes CONV_AB_CPU.json; prints one JSON line. Grid sizes via
 MFU_CLIENTS/MFU_STEPS/MFU_ROUNDS (kept small: 1-core host).
@@ -92,8 +92,8 @@ def main() -> int:
         "backend": "cpu (XLA, 1 core)",
         "caveat": ("XLA-compiled identical round programs on the CPU "
                    "backend; no MXU — ratios are evidence, not the "
-                   "on-chip decision (see scripts/tpu_capture_r5.sh "
-                   "queue). FLOPs numerator is the conv lowering's "
+                   "on-chip decision (see the tpu_capture.sh conv-ab "
+                   "step). FLOPs numerator is the conv lowering's "
                    "cost analysis for every row. Speedups are ratios "
                    "of the unrounded timed segments (identical step "
                    "counts per batch)."),

@@ -1,0 +1,24 @@
+"""Per-host auto-restart harness (thin wrapper).
+
+Relaunches a training command with ``--resume <ckpt_dir>`` whenever it
+exits with the restartable code 75 (EX_TEMPFAIL) — the code the
+preemption drain and the stall watchdog exit with — under an
+exponential-backoff, progress-gated retry budget. The logic lives in
+``fedtorch_tpu.robustness.harness`` (also exposed as the
+``fedtorch-tpu supervise`` subcommand); see docs/robustness.md
+"Process lifecycle".
+
+Usage:
+    python scripts/run_elastic.py --ckpt_dir /runs/exp1 -- \
+        python -m fedtorch_tpu.cli --federated true ... --run_dir /runs/exp1
+"""
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fedtorch_tpu.robustness.harness import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -20,14 +20,16 @@ def free_port() -> int:
 
 
 def run_workers(script: str, extra_args, n_procs: int, *,
-                timeout: int = 420):
+                timeout: int = 420, expect_rc: int = 0):
     """Launch ``n_procs`` coordinated worker processes of ``script``
     (argv: port, pid, *extra_args) and return their merged outputs.
 
     Single source of the fan-out plumbing: fresh port, TPU-proxy env
     scrub, repo-root PYTHONPATH, communicate-with-timeout + kill-all,
-    per-pid returncode assertion. Used by test_multihost.py and
-    test_multihost_resume.py."""
+    per-pid returncode assertion (``expect_rc``; the watchdog drill
+    expects the restartable code 75 instead of 0). Used by
+    test_multihost.py, test_multihost_resume.py and
+    test_watchdog_drill.py."""
     import subprocess
     import sys
 
@@ -58,7 +60,9 @@ def run_workers(script: str, extra_args, n_procs: int, *,
             pytest.fail(f"{os.path.basename(script)}: worker timed out")
         outs.append(out)
     for pid, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+        assert p.returncode == expect_rc, (
+            f"worker {pid} exited {p.returncode} "
+            f"(expected {expect_rc}):\n{out}")
     return outs
 
 

@@ -5,16 +5,23 @@ Each process owns 2 virtual CPU devices; 4 processes form an 8-device
 global mesh. Three modes replay the same seeded experiment
 (bring-up shared with the 2-process smoke via mh_common.py):
 
-  full    — 4 uninterrupted rounds; print every round's fingerprint
-  first   — rounds 1-2, collective checkpoint, exit (the "crash")
-  resume  — fresh processes restore the cross-host checkpoint and run
-            rounds 3-4; print those rounds' fingerprints
+  full     — 4 uninterrupted rounds; print every round's fingerprint
+  first    — rounds 1-2, collective checkpoint, exit (the "crash")
+  resume   — fresh processes restore the cross-host checkpoint and run
+             rounds 3-4; print those rounds' fingerprints
+  degraded — 2 processes (a 4-device mesh: the "surviving slice" after
+             losing half the pod) restore the SAME 8-device-mesh
+             checkpoint and run rounds 3-4
 
-``full``'s rounds 3-4 and ``resume``'s rounds 3-4 must print IDENTICAL
-per-round fingerprints: the checkpoint carries full round state
-(server+client params, aux, counters, PRNG), so an interrupted run is
-bit-indistinguishable from an uninterrupted one round by round —
-across a simulated DCN boundary. Run as:
+``full``'s rounds 3-4 and ``resume``'s / ``degraded``'s rounds 3-4
+must print IDENTICAL per-round fingerprints: the checkpoint carries
+full round state (server+client params, aux, counters, PRNG) for the
+REAL clients only — the mesh-dependent padding tail is stripped on
+save and re-grafted on restore — so an interrupted run is
+bit-indistinguishable from an uninterrupted one round by round, across
+a simulated DCN boundary AND across a mesh-shape change (the
+degraded-pod resume contract, docs/multihost.md "Failure model").
+Run as:
 
     python tests/multihost_resume_worker.py <port> <pid> <mode> <ckpt_dir>
 """
@@ -28,13 +35,14 @@ port, pid, mode, ckpt_dir = (sys.argv[1], int(sys.argv[2]),
                              sys.argv[3], sys.argv[4])
 configure_env(local_devices=2)  # before the first jax import
 
-jax, cfg, trainer = bringup(port, pid, num_processes=4,
+n_procs = 2 if mode == "degraded" else 4
+jax, cfg, trainer = bringup(port, pid, num_processes=n_procs,
                             local_devices=2, online_client_rate=0.5)
 from fedtorch_tpu.utils import maybe_resume, save_checkpoint  # noqa: E402
 
 server, clients = trainer.init_state(jax.random.key(0))
 
-if mode == "resume":
+if mode in ("resume", "degraded"):
     server, clients, best, resumed = maybe_resume(
         ckpt_dir, server, clients, cfg, None)
     assert resumed and int(server.round) == 2, (resumed, server.round)

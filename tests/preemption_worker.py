@@ -1,0 +1,89 @@
+"""Worker for the kill drill (test_kill_drill.py) and the chaos-suite
+lifecycle drill: a real `run_experiment` round loop that prints one
+bitwise fingerprint per completed round and honors the preemption
+drain contract end to end.
+
+The worker is the CLI driver loop verbatim (cli.run_experiment with a
+round_callback), so the drill exercises the production code path:
+SIGTERM mid-run → flag → SPMD stop poll at the round boundary → final
+checkpoint + async drain → exit 75. The restart harness then relaunches
+it with ``--resume <ckpt>`` and the remaining rounds' fingerprints must
+equal an uninterrupted run's (tests/mh_common.round_fingerprint — repr
+precision, so the comparison is bitwise).
+
+    python tests/preemption_worker.py --ckpt DIR --rounds N \
+        [--async_checkpoint] [--slow_writes S] [--round_sleep S] \
+        [--resume DIR]
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+p = argparse.ArgumentParser()
+p.add_argument("--ckpt", required=True, help="run directory (--run_dir)")
+p.add_argument("--rounds", type=int, default=6)
+p.add_argument("--resume", default=None)
+p.add_argument("--async_checkpoint", action="store_true")
+p.add_argument("--slow_writes", type=float, default=0.0,
+               help="inject this many seconds into every checkpoint "
+                    "write — puts a write in flight at kill time")
+p.add_argument("--round_sleep", type=float, default=0.0,
+               help="sleep after each round so the test can land a "
+                    "SIGTERM mid-run deterministically")
+p.add_argument("--eval_freq", type=int, default=1)
+args = p.parse_args()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from mh_common import round_fingerprint  # noqa: E402
+
+from fedtorch_tpu.cli import (  # noqa: E402
+    args_to_config, build_parser, run_experiment,
+)
+
+if args.slow_writes > 0:
+    # slow the WRITE half only (serialization + disk, the part the
+    # async worker thread owns) — the snapshot stays on the caller
+    from fedtorch_tpu.utils import checkpoint as ckpt_mod
+    _orig_write = ckpt_mod._write_checkpoint
+
+    def _slow_write(*a, **kw):
+        time.sleep(args.slow_writes)
+        return _orig_write(*a, **kw)
+
+    ckpt_mod._write_checkpoint = _slow_write
+
+cli_args = [
+    "--federated", "true", "-d", "synthetic", "-a",
+    "logistic_regression", "--num_comms", str(args.rounds),
+    "--num_workers", "6", "--online_client_rate", "0.5",
+    "--federated_sync_type", "local_step", "--local_step", "2",
+    "--batch_size", "8", "--lr", "0.1",
+    "--eval_freq", str(args.eval_freq),
+    "--debug", "false", "--run_dir", args.ckpt,
+]
+if args.async_checkpoint:
+    cli_args.append("--async_checkpoint")
+if args.resume:
+    cli_args += ["--resume", args.resume]
+cfg = args_to_config(build_parser().parse_args(cli_args))
+
+
+def callback(r, trainer, server, clients, metrics):
+    fp = round_fingerprint(jax, trainer, server, clients, metrics)
+    print(f"TRAJ round={r} {fp}", flush=True)
+    if args.round_sleep > 0:
+        time.sleep(args.round_sleep)
+
+
+res = run_experiment(cfg, round_callback=callback)
+if res.get("preempted"):
+    print(f"PREEMPTED at_round={res['preempted_at_round']}", flush=True)
+    sys.exit(75)
+print("DONE", flush=True)

@@ -19,3 +19,14 @@ def test_chaos_suite_within_tolerance():
         assert entry["gap_points"] <= 5.0
         assert entry["faults_injected"]["dropped"] > 0
         assert entry["faults_injected"]["rejected"] > 0
+
+
+@pytest.mark.slow
+def test_kill_drill_lifecycle(tmp_path):
+    """Process-lifecycle chaos (ISSUE 4): the real CLI, SIGTERMed
+    mid-run, drains + exits 75; the restart harness relaunches it with
+    --resume and the job runs to completion."""
+    from chaos_suite import run_kill_drill
+    report = run_kill_drill(rounds=60, ckpt_root=str(tmp_path))
+    assert report["launches"] >= 2
+    assert report["final_round"] == 60

@@ -1,0 +1,189 @@
+"""Checkpoint lifecycle satellites (ISSUE 4): bounded retention
+(``checkpoint.keep_last_n``), the pinned ``run_dir``, and resume edge
+cases — a corrupt ``checkpoint.json`` beside a valid per-round keep,
+and the heavily-padded template graft (``num_clients`` < device
+count, the mesh-shape-independence contract the degraded-pod resume
+rides on)."""
+import json
+import os
+
+import jax
+import pytest
+
+from fedtorch_tpu.algorithms import make_algorithm
+from fedtorch_tpu.config import (
+    CheckpointConfig, DataConfig, ExperimentConfig, FederatedConfig,
+    ModelConfig, OptimConfig, TrainConfig,
+)
+from fedtorch_tpu.data import build_federated_data
+from fedtorch_tpu.models import define_model
+from fedtorch_tpu.parallel import FederatedTrainer
+from fedtorch_tpu.utils import (
+    init_checkpoint_dir, maybe_resume, save_checkpoint,
+)
+from fedtorch_tpu.utils.checkpoint import collect_round_keeps
+
+
+def make_experiment(num_clients=6, ckpt_kw=None):
+    cfg = ExperimentConfig(
+        data=DataConfig(dataset="synthetic", synthetic_dim=10,
+                        batch_size=8),
+        federated=FederatedConfig(
+            federated=True, num_clients=num_clients, num_comms=4,
+            online_client_rate=0.5, algorithm="fedavg",
+            sync_type="local_step"),
+        model=ModelConfig(arch="logistic_regression"),
+        optim=OptimConfig(lr=0.1, weight_decay=0.0),
+        train=TrainConfig(local_step=2),
+        checkpoint=CheckpointConfig(**(ckpt_kw or {})),
+    ).finalize()
+    data = build_federated_data(cfg)
+    model = define_model(cfg, batch_size=cfg.data.batch_size)
+    trainer = FederatedTrainer(cfg, model, make_algorithm(cfg),
+                               data.train)
+    server, clients = trainer.init_state(jax.random.key(0))
+    return cfg, trainer, server, clients
+
+
+def _round_keeps(d):
+    return sorted(f for f in os.listdir(d)
+                  if f.startswith("checkpoint_r"))
+
+
+# -- bounded retention -------------------------------------------------------
+class TestKeepLastN:
+    def test_gc_keeps_newest_n(self, tmp_path):
+        d = str(tmp_path)
+        cfg, trainer, server, clients = make_experiment(
+            ckpt_kw={"keep_last_n": 2})
+        for _ in range(5):
+            server, clients, _ = trainer.run_round(server, clients)
+            save_checkpoint(d, server, clients, cfg, 0.0, False,
+                            save_all=True)
+        assert _round_keeps(d) == ["checkpoint_r4.ckpt",
+                                   "checkpoint_r5.ckpt"]
+        # checkpoint.ckpt itself is never a GC candidate
+        assert os.path.exists(os.path.join(d, "checkpoint.ckpt"))
+
+    def test_default_unlimited_preserves_save_all(self, tmp_path):
+        d = str(tmp_path)
+        cfg, trainer, server, clients = make_experiment()
+        assert cfg.checkpoint.keep_last_n == 0
+        for _ in range(4):
+            server, clients, _ = trainer.run_round(server, clients)
+            save_checkpoint(d, server, clients, cfg, 0.0, False,
+                            save_all=True)
+        assert len(_round_keeps(d)) == 4  # save_all semantics intact
+
+    def test_model_best_never_collected(self, tmp_path):
+        d = str(tmp_path)
+        cfg, trainer, server, clients = make_experiment(
+            ckpt_kw={"keep_last_n": 1})
+        for i in range(3):
+            server, clients, _ = trainer.run_round(server, clients)
+            save_checkpoint(d, server, clients, cfg, 0.5, is_best=True,
+                            save_all=True)
+        assert _round_keeps(d) == ["checkpoint_r3.ckpt"]
+        assert os.path.exists(os.path.join(d, "model_best.ckpt"))
+        assert os.path.exists(os.path.join(d, "model_best.json"))
+
+    def test_collect_round_keeps_sorts_numerically(self, tmp_path):
+        d = str(tmp_path)
+        # r10 must outrank r9 (lexical order would GC it)
+        for r in (2, 9, 10):
+            with open(os.path.join(d, f"checkpoint_r{r}.ckpt"),
+                      "wb") as f:
+                f.write(b"x")
+        removed = collect_round_keeps(d, 2)
+        assert [os.path.basename(p) for p in removed] == \
+            ["checkpoint_r2.ckpt"]
+        assert _round_keeps(d) == ["checkpoint_r10.ckpt",
+                                   "checkpoint_r9.ckpt"]
+
+    def test_resumed_run_gc_spans_earlier_attempts(self, tmp_path):
+        """Retention is directory-wide, not per-process: keeps written
+        by the pre-restart attempt are collected by the resumed one."""
+        d = str(tmp_path)
+        cfg, trainer, server, clients = make_experiment(
+            ckpt_kw={"keep_last_n": 2})
+        for _ in range(2):  # "first attempt": rounds 1-2
+            server, clients, _ = trainer.run_round(server, clients)
+            save_checkpoint(d, server, clients, cfg, 0.0, False,
+                            save_all=True)
+        for _ in range(2):  # "restarted attempt": rounds 3-4
+            server, clients, _ = trainer.run_round(server, clients)
+            save_checkpoint(d, server, clients, cfg, 0.0, False,
+                            save_all=True)
+        assert _round_keeps(d) == ["checkpoint_r3.ckpt",
+                                   "checkpoint_r4.ckpt"]
+
+
+# -- run_dir -----------------------------------------------------------------
+class TestRunDir:
+    def test_run_dir_used_exactly(self, tmp_path):
+        d = str(tmp_path / "stable")
+        cfg, *_ = make_experiment(ckpt_kw={"run_dir": d})
+        assert init_checkpoint_dir(cfg) == d
+        assert os.path.isdir(d)
+
+    def test_default_keeps_hyperparam_layout(self, tmp_path):
+        cfg, *_ = make_experiment(
+            ckpt_kw={"checkpoint_dir": str(tmp_path)})
+        path = init_checkpoint_dir(cfg)
+        # <root>/<dataset>/<arch>/<hyperparam folder>
+        assert path.startswith(
+            os.path.join(str(tmp_path), "synthetic",
+                         "logistic_regression"))
+
+
+# -- resume edge cases -------------------------------------------------------
+class TestResumeEdgeCases:
+    def test_corrupt_meta_beside_valid_keep_skips_cleanly(
+            self, tmp_path):
+        """checkpoint_index resume reads checkpoint.json for compat:
+        undecodable meta beside a perfectly valid per-round .ckpt must
+        skip resume with a warning, not die on a JSON traceback."""
+        d = str(tmp_path)
+        cfg, trainer, server, clients = make_experiment()
+        server, clients, _ = trainer.run_round(server, clients)
+        save_checkpoint(d, server, clients, cfg, 0.0, False,
+                        save_all=True)
+        assert os.path.exists(os.path.join(d, "checkpoint_r1.ckpt"))
+        with open(os.path.join(d, "checkpoint.json"), "w") as f:
+            f.write('{"arguments": {truncated')
+        s2, c2 = trainer.init_state(jax.random.key(0))
+        with pytest.warns(RuntimeWarning, match="undecodable meta"):
+            s3, c3, best, resumed = maybe_resume(d, s2, c2, cfg, "1")
+        assert not resumed and best == 0.0
+        assert int(jax.device_get(s3.round)) == 0  # fresh state kept
+
+    def test_resume_with_fewer_clients_than_devices(self, tmp_path):
+        """num_clients < device count: the 8-device test mesh pads 3
+        clients to 8 slots — the checkpoint carries ONLY the 3 real
+        clients and the graft must land them in the padded template
+        with the trajectory intact (the same contract, at the padding
+        extreme, that degraded-pod resume relies on)."""
+        d = str(tmp_path)
+        C = 3
+        cfg, trainer, server, clients = make_experiment(num_clients=C)
+        assert trainer.padded_clients >= jax.device_count() > C
+        fingerprints = []
+        for _ in range(4):
+            server, clients, m = trainer.run_round(server, clients)
+            jax.block_until_ready(server.params)
+            fingerprints.append(repr(float(m.train_loss.sum())))
+        # checkpoint at round 2 of a REPLAY from the same seed
+        cfg2, tr2, s2, c2 = make_experiment(num_clients=C)
+        for _ in range(2):
+            s2, c2, _ = tr2.run_round(s2, c2)
+        save_checkpoint(d, s2, c2, cfg2, 0.0, False)
+        # fresh trainer resumes and must reproduce rounds 3-4 bitwise
+        cfg3, tr3, s3, c3 = make_experiment(num_clients=C)
+        s3, c3, _, resumed = maybe_resume(d, s3, c3, cfg3, None)
+        assert resumed and int(jax.device_get(s3.round)) == 2
+        tail = []
+        for _ in range(2):
+            s3, c3, m = tr3.run_round(s3, c3)
+            jax.block_until_ready(s3.params)
+            tail.append(repr(float(m.train_loss.sum())))
+        assert tail == fingerprints[2:]

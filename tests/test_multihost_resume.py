@@ -10,12 +10,17 @@ Three waves of 4 coordinated processes (2 virtual CPU devices each →
   wave B  "first"  — rounds 1-2 + collective snapshot  (the "crash")
   wave C  "resume" — NEW processes restore the checkpoint, rounds 3-4
                                                    → fingerprints 3-4
+  wave D  "degraded" — only 2 processes (a 4-device mesh, the
+          "surviving slice" after losing half the pod) restore the
+          same 8-device-mesh checkpoint, rounds 3-4 → fingerprints 3-4
 
 Asserts, per round and bit-for-bit (full-precision reprs of loss sum /
 mean epoch / param norm): every process agrees within a wave, and wave
-C's rounds 3-4 equal wave A's — the checkpoint carries full round
-state (params, aux, counters, PRNG), so recovery is exact and
-cross-host.
+C's AND wave D's rounds 3-4 equal wave A's — the checkpoint carries
+full round state (params, aux, counters, PRNG) for the real clients
+only, so recovery is exact, cross-host, and *mesh-shape independent*
+(the degraded-pod resume contract: an N-host checkpoint restores on an
+M<N-host slice, docs/multihost.md "Failure model").
 """
 import os
 import re
@@ -60,3 +65,12 @@ def test_four_process_interrupt_resume_matches_uninterrupted(tmp_path):
     # the interrupted-and-restored rounds 3-4 are bit-identical, round
     # by round, to the uninterrupted run's rounds 3-4
     assert resumed[0] == full[0][2:], (full[0], resumed[0])
+
+    # wave D: the same checkpoint restores on HALF the pod (2 procs, a
+    # 4-device mesh vs the 8-device writer) and the trajectory is
+    # still bit-identical — mesh-shape independence is what lets the
+    # restart harness come back on whatever slice survived
+    degraded = _trajectories(run_workers(_WORKER, ["degraded", ckpt], 2))
+    assert all(len(t) == 2 for t in degraded), degraded
+    assert all(t == degraded[0] for t in degraded[1:]), degraded
+    assert degraded[0] == full[0][2:], (full[0], degraded[0])

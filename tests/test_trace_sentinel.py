@@ -83,6 +83,30 @@ def test_round_traces_once_under_faults(algorithm):
     s.assert_traces(trainer.trace_name, expected=1)
 
 
+def test_round_traces_once_with_lifecycle_armed():
+    """Process lifecycle (ISSUE 4) is host-only: with the stall
+    watchdog armed AND a stop signal folded into the per-round scalar
+    fetch, the round program still traces exactly once — the 'zero
+    overhead when off, host-only when on' contract (the static half —
+    byte-identical HLO — is pinned by test_preemption.py)."""
+    from fedtorch_tpu.robustness import StallWatchdog
+
+    trainer = make_trainer(
+        "fedavg", fault_kw=dict(watchdog_timeout_s=60.0))
+    trainer.attach_stop_signal(lambda: False)
+    server, clients = trainer.init_state(jax.random.key(3))
+    with StallWatchdog(60.0, exit_fn=lambda code: None) as wd:
+        with RecompilationSentinel() as s:
+            for r in range(3):
+                server, clients, metrics = trainer.run_round(
+                    server, clients)
+                sc = trainer.round_host_scalars(clients, metrics)
+                assert sc["stop"] == 0.0
+                wd.heartbeat(r)
+    s.assert_traces(trainer.trace_name, expected=1)
+    assert not wd.fired
+
+
 def test_sentinel_catches_retraces():
     """Positive control: the sentinel machinery itself must see a
     retrace when one genuinely happens (new shape => new trace)."""

@@ -1,0 +1,38 @@
+"""Watchdog drill (ISSUE 4 acceptance): a deliberately wedged round —
+one blocked worker in a 2-process pod — converts to exit code 75
+within the timeout, with thread stacks in the log.
+
+Process 1 stops participating before round 2; process 0 blocks inside
+the round's DCN collective (the silent lost-host hang of
+docs/multihost.md "Failure model"). Both processes' StallWatchdogs
+must fire: exit code 75 (restartable — the harness relaunches on the
+surviving slice) and a full thread-stack dump naming the wedged
+MainThread.
+"""
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from mh_common import run_workers  # noqa: E402
+
+_WORKER = os.path.join(os.path.dirname(__file__), "watchdog_worker.py")
+TIMEOUT_S = 6.0
+
+
+@pytest.mark.slow
+def test_wedged_round_exits_75_with_stacks():
+    outs = run_workers(_WORKER, [TIMEOUT_S], 2, timeout=180,
+                       expect_rc=75)
+    for pid, out in enumerate(outs):
+        # both completed round 0 and 1, neither completed round 2
+        assert f"ROUND pid={pid} r=1" in out, out
+        assert f"ROUND pid={pid} r=2" not in out, out
+        # the watchdog named the failure and dumped every thread
+        assert "StallWatchdog: no round completed in" in out, out
+        assert "--- Thread MainThread" in out, out
+        # the wedged collective (pid 0) / sleep (pid 1) is visible in
+        # the dump — the post-mortem an operator needs
+        assert "stall-watchdog" in out, out
+    assert "WEDGE pid=1" in outs[1], outs[1]

@@ -1,0 +1,48 @@
+"""Worker for the watchdog drill (test_watchdog_drill.py): one wedged
+process stalls the pod; every process's StallWatchdog must convert the
+silent hang into a restartable exit within the timeout.
+
+Two coordinated processes (2 virtual CPU devices each, a 4-device DCN
+mesh) run federated rounds with the stall watchdog armed. After round
+1, process 1 "dies" (sleeps forever without entering round 2 — the
+lost-host failure of docs/multihost.md). Process 0 blocks inside round
+2's cross-process collective with NO exception to catch; its watchdog
+sees no heartbeat, dumps every thread's stack to stderr, and hard-exits
+75. Process 1's watchdog fires the same way (no round completed there
+either). The restart harness would then relaunch both on the surviving
+slice — the degraded-pod resume path proven by
+test_multihost_resume.py.
+
+    python tests/watchdog_worker.py <port> <pid> <timeout_s>
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from mh_common import bringup, configure_env  # noqa: E402
+
+port, pid, timeout_s = sys.argv[1], int(sys.argv[2]), float(sys.argv[3])
+configure_env(local_devices=2)  # before the first jax import
+
+jax, cfg, trainer = bringup(port, pid, num_processes=2,
+                            local_devices=2, online_client_rate=0.5)
+from fedtorch_tpu.robustness import StallWatchdog  # noqa: E402
+
+server, clients = trainer.init_state(jax.random.key(0))
+watchdog = StallWatchdog(timeout_s).start()
+
+for r in range(6):
+    if pid == 1 and r == 2:
+        # the "dead host": never enters round 2's collective. Its own
+        # watchdog fires too — no round completes here either.
+        print(f"WEDGE pid={pid} before round {r}", flush=True)
+        time.sleep(3600)
+    server, clients, metrics = trainer.run_round(server, clients)
+    jax.block_until_ready(server.params)
+    watchdog.heartbeat(r)
+    print(f"ROUND pid={pid} r={r}", flush=True)
+
+# unreachable when the drill works: the watchdog exits 75 first
+watchdog.stop()
+print(f"COMPLETED pid={pid}", flush=True)
