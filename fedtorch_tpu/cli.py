@@ -135,6 +135,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--avg_model", type=str2bool, default=True)
     p.add_argument("--reshuffle_per_epoch", type=str2bool, default=False)
     p.add_argument("-b", "--batch_size", type=int, default=50)
+    p.add_argument("--data_plane", default="device",
+                   choices=("device", "stream"),
+                   help="federated data plane: 'device' keeps every "
+                        "client's rows resident in device memory "
+                        "(population capped by HBM); 'stream' keeps "
+                        "the client store on the host and prefetches "
+                        "each round's packed online-client rows one "
+                        "round ahead, overlapping the transfer with "
+                        "the previous round's compute "
+                        "(docs/performance.md 'Streaming data plane')")
     p.add_argument("--growing_batch_size", type=str2bool, default=False)
     p.add_argument("--base_batch_size", type=int, default=None)
     p.add_argument("--max_batch_size", type=int, default=0)
@@ -291,6 +301,7 @@ def args_to_config(args) -> ExperimentConfig:
             synthetic_alpha=args.synthetic_alpha,
             synthetic_beta=args.synthetic_beta,
             sensitive_feature=args.sensitive_feature,
+            data_plane=args.data_plane,
             batch_size=args.batch_size,
             growing_batch_size=args.growing_batch_size,
             base_batch_size=args.base_batch_size,
@@ -653,6 +664,11 @@ def run_experiment(cfg: ExperimentConfig,
         # outlive the loop in library callers
         watchdog.stop()
         preempt.restore()
+        # streaming data plane: stop the feed producer and drop any
+        # in-flight prefetch — a preemption drain (exit 75) must not
+        # leave a worker thread blocked on the feed queue, and a
+        # library caller resuming this trainer later re-syncs cleanly
+        trainer.invalidate_stream()
         if async_ckpt is not None:
             # flush pending writes even when the loop raised — the
             # checkpoint the user would resume from must hit disk. A

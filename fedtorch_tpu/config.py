@@ -63,6 +63,15 @@ class DataConfig:
     synthetic_regression: bool = False
     # Adult sensitive-feature split (ref: parameters.py:37).
     sensitive_feature: int = 9
+    # Federated data plane (docs/performance.md "Streaming data
+    # plane"): 'device' shards every client's rows into HBM at trainer
+    # construction and hands the full [C, n_max, ...] pytree to each
+    # jitted round (the reference-faithful seed behavior — population
+    # capped by device memory); 'stream' keeps the client store
+    # host-resident and feeds each round the K online clients' packed
+    # rows, built and transferred one round ahead of device compute
+    # (population capped by host RAM; bitwise-identical trajectories).
+    data_plane: str = "device"
     # Batching (ref: parameters.py:131-141).
     batch_size: int = 50
     growing_batch_size: bool = False
@@ -462,6 +471,10 @@ class ExperimentConfig:
             optim = dataclasses.replace(
                 optim, out_momentum_factor=1.0 - 1.0 / n)
 
+        if data.data_plane not in ("device", "stream"):
+            raise ValueError(
+                f"data.data_plane must be 'device' or 'stream', got "
+                f"{data.data_plane!r}")
         if fed.algorithm not in FEDERATED_ALGORITHMS:
             raise ValueError(f"Unknown federated algorithm {fed.algorithm!r}; "
                              f"expected one of {FEDERATED_ALGORITHMS}")

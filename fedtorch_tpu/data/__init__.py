@@ -18,6 +18,9 @@ from fedtorch_tpu.data.batching import (  # noqa: F401
     stack_partitions, take_batch, train_val_split,
 )
 from fedtorch_tpu.data.datasets import DatasetSplits, get_dataset  # noqa: F401
+from fedtorch_tpu.data.streaming import (  # noqa: F401
+    HostClientStore, RoundFeed, StreamFeedProducer, feed_nbytes,
+)
 from fedtorch_tpu.data.partition import (  # noqa: F401
     dirichlet_partition, growing_batch_partition, iid_partition,
     label_sorted_partition, partition_sizes, sensitive_group_partition,

@@ -107,6 +107,29 @@ def epoch_permutation(rng: jax.Array, size: jnp.ndarray,
     return jnp.argsort(keys)
 
 
+# Disjoint parent fold for a client's validation stream: the round
+# program's dropout keys use folds [1, K] and augmentation 0x7FFFFFFF,
+# so val lives at 0x7FFFFFFE (the train stream's fold 0 is already
+# outside the dropout range).
+VAL_FOLD = 0x7FFFFFFE
+
+
+def round_row_plan(rng_c: jax.Array, size: jnp.ndarray, n_max: int,
+                   num_rows: int, fold: int = 0) -> jnp.ndarray:
+    """One client's row plan for a whole round: ``perm[(step*B + j) %
+    size]`` for all ``num_rows = K*B`` (step, j) pairs — the
+    :func:`epoch_permutation`/:func:`take_batch` batch order flattened
+    (fold 0 = train stream, :data:`VAL_FOLD` = val stream).
+
+    THE single definition of a round's batch order: the device round
+    program ('batch' gather mode, parallel/federated.py) and the host
+    streaming feed packer (data/streaming.py) both call it, so the two
+    data planes cannot drift apart — which is what makes the
+    ``data_plane='stream'`` bitwise-parity contract testable."""
+    perm = epoch_permutation(jax.random.fold_in(rng_c, fold), size, n_max)
+    return perm[jnp.arange(num_rows) % jnp.maximum(size, 1)]
+
+
 def take_batch(data_x: jnp.ndarray, data_y: jnp.ndarray,
                perm: jnp.ndarray, size: jnp.ndarray,
                step_in_epoch: jnp.ndarray, batch_size: int):

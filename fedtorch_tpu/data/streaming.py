@@ -1,0 +1,252 @@
+"""Streaming data plane: host-resident client store + round-ahead feeds.
+
+The device data plane (the seed behavior) shards the ENTIRE federation
+dataset into HBM at trainer construction and hands the full
+``[C, n_max, ...]`` pytree to every jitted round — population size is
+capped by device memory even though a round only ever touches the K
+online clients' ``K*B`` rows. ``cfg.data.data_plane='stream'`` keeps
+the client store host-resident (numpy) and turns each round's working
+set into a packed :class:`RoundFeed`:
+
+* **Schedule replay.** Participation and per-client batch order derive
+  deterministically from the threefry key schedule
+  (``fold_in(server.rng, round)`` → ``participation_indices`` →
+  ``round_row_plan``). :class:`RoundSchedule` runs the SAME jax PRNG
+  ops on the CPU backend, so the host knows round r+1's exact index
+  plan without touching the accelerator stream.
+* **Packed gather.** The K online clients' rows are gathered from the
+  host store with the native multithreaded ``ft_gather_rows`` (numpy
+  fallback — bitwise-identical output either way, pinned by
+  tests/test_streaming.py) into ``[k, K*B, ...]`` feed tensors.
+* **Round-ahead overlap.** A background producer
+  (:class:`~fedtorch_tpu.native.host_pipeline.HostPrefetcher`) builds
+  and ``jax.device_put``\\ s round r+1's feed WHILE round r computes —
+  double-buffered, so the steady-state H2D transfer hides under device
+  compute and device-side data residency drops from ``O(C*n_max)`` to
+  ``O(2*k*K*B)``.
+
+The trainer-side consumer is ``FederatedTrainer.round_stream_fn``
+(parallel/federated.py), which funnels the feed into the same
+``_round_core`` the device plane uses — the bitwise-parity contract.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedtorch_tpu.data.batching import ClientData, round_row_plan
+from fedtorch_tpu.native.host_pipeline import HostPrefetcher, gather_rows
+
+
+class RoundFeed(NamedTuple):
+    """One round's device inputs under the streaming plane.
+
+    ``x``/``y`` hold the round's pre-selected rows in
+    ``round_row_plan`` order (the 'batch' gather layout);
+    ``pre_x``/``pre_y`` are each online client's first B storage-order
+    rows — what the ``pre_round`` hook sees in every gather mode."""
+    idx: jnp.ndarray      # [k] int32 online-client ids
+    sizes: jnp.ndarray    # [k] int32 true sample counts
+    x: jnp.ndarray        # [k, K*B, ...]
+    y: jnp.ndarray        # [k, K*B, ...]
+    pre_x: jnp.ndarray    # [k, B, ...]
+    pre_y: jnp.ndarray    # [k, B, ...]
+
+
+def feed_nbytes(feed: RoundFeed) -> int:
+    """Byte count of one packed feed (the unit of the streaming
+    plane's device residency: steady state holds at most the prefetch
+    depth of these, not the client store). Delegates to the one byte
+    accounting helper (``core.state.tree_bytes`` — also the
+    comm_bytes unit), so the two cannot drift."""
+    from fedtorch_tpu.core.state import tree_bytes
+    return int(tree_bytes(feed))
+
+
+class HostClientStore:
+    """The host-resident client store: ``[C, n_max, ...]`` numpy arrays
+    plus flat row views, so one round's feed is ONE (native,
+    multithreaded) row gather per tensor instead of per-client copies.
+
+    This is the piece that unbinds population size from HBM: the store
+    can be as large as host RAM (or an mmap of parsed buffers — the
+    arrays are never copied here if already contiguous numpy)."""
+
+    def __init__(self, data: ClientData):
+        self.x = np.ascontiguousarray(np.asarray(data.x))
+        self.y = np.ascontiguousarray(np.asarray(data.y))
+        self.sizes = np.ascontiguousarray(np.asarray(data.sizes),
+                                          dtype=np.int32)
+        self.num_clients, self.n_max = self.x.shape[:2]
+        self._flat_x = self.x.reshape((self.num_clients * self.n_max,)
+                                      + self.x.shape[2:])
+        self._flat_y = self.y.reshape((self.num_clients * self.n_max,)
+                                      + self.y.shape[2:])
+        # ft_gather_rows indexes with int32; a store past 2^31-1 total
+        # rows falls back to numpy fancy indexing
+        self._native_ok = (self.num_clients * self.n_max
+                           <= np.iinfo(np.int32).max)
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.x.nbytes + self.y.nbytes)
+
+    def _gather(self, src: np.ndarray, flat_rows: np.ndarray) -> np.ndarray:
+        if self._native_ok:
+            return gather_rows(src, flat_rows.astype(np.int32))
+        return np.ascontiguousarray(src[flat_rows])
+
+    def pack(self, idx: np.ndarray, rows: np.ndarray,
+             batch_size: int) -> RoundFeed:
+        """Pack one round's feed: client ``idx[i]``'s rows ``rows[i]``
+        plus its first ``batch_size`` storage-order rows (the
+        ``pre_round`` hook batch). Output is bitwise-identical whether
+        the native library or the numpy fallback does the gather."""
+        idx = np.asarray(idx, np.int64)
+        rows = np.asarray(rows, np.int64)
+        k, num_rows = rows.shape
+        flat = (idx[:, None] * self.n_max + rows).reshape(-1)
+        # clamp like the device plane's jnp gather does: with
+        # batch_size > n_max the hook batch repeats the last row
+        # instead of walking into the next client's shard
+        pre_cols = np.minimum(np.arange(batch_size, dtype=np.int64),
+                              self.n_max - 1)
+        pre = (idx[:, None] * self.n_max + pre_cols[None, :]).reshape(-1)
+        feat_x, feat_y = self.x.shape[2:], self.y.shape[2:]
+        return RoundFeed(
+            idx=idx.astype(np.int32),
+            sizes=self.sizes[idx],
+            x=self._gather(self._flat_x, flat).reshape(
+                (k, num_rows) + feat_x),
+            y=self._gather(self._flat_y, flat).reshape(
+                (k, num_rows) + feat_y),
+            pre_x=self._gather(self._flat_x, pre).reshape(
+                (k, batch_size) + feat_x),
+            pre_y=self._gather(self._flat_y, pre).reshape(
+                (k, batch_size) + feat_y))
+
+
+def _cpu_device():
+    """The CPU backend device for schedule replay, or None when the
+    platform list excludes it (JAX_PLATFORMS=tpu): the schedule is a
+    few-KB computation, so falling back to the default device is
+    correct, just not free."""
+    try:
+        return jax.devices("cpu")[0]
+    except RuntimeError:
+        return None
+
+
+class RoundSchedule:
+    """Host replica of the round program's index schedule.
+
+    Given the server PRNG key (its raw ``key_data``) and a round
+    number, reproduces EXACTLY the ``(idx, rows)`` the device round
+    program would derive: the same ``fold_in``/``split`` chain, the
+    same ``participation_indices``, the same ``round_row_plan`` —
+    threefry is backend-deterministic and ``argsort`` is stable, so
+    the CPU-backend replay is bit-exact. One jitted schedule function
+    (static shapes) serves every round; it traces once."""
+
+    def __init__(self, key_data: np.ndarray, key_impl, num_clients: int,
+                 k_online: int, num_rows: int, n_max: int,
+                 sizes: np.ndarray):
+        # lazy import: parallel.federated imports this module at load
+        from fedtorch_tpu.parallel.federated import participation_indices
+
+        self._cpu = _cpu_device()
+        sizes = np.asarray(sizes, np.int32)
+
+        def sched(key, round_idx):
+            rng_round = jax.random.fold_in(key, round_idx)
+            rng_sample, rng_train = jax.random.split(rng_round)
+            idx = participation_indices(rng_sample, num_clients, k_online,
+                                        round_idx)
+            on_sizes = jnp.take(jnp.asarray(sizes), idx)
+            rngs = jax.random.split(rng_train, k_online)
+            rows = jax.vmap(lambda r, s: round_row_plan(
+                r, s, n_max, num_rows))(rngs, on_sizes)
+            return idx, rows
+
+        with self._scope():
+            self._key = jax.random.wrap_key_data(
+                jnp.asarray(np.asarray(key_data)), impl=key_impl)
+            # the key input is REUSED by every round's replay
+            # (donation would invalidate it); outputs are a few KB
+            # lint: disable=FTL004 — inputs reused every round
+            self._jit = jax.jit(sched)
+
+    def _scope(self):
+        return jax.default_device(self._cpu) if self._cpu is not None \
+            else contextlib.nullcontext()
+
+    def __call__(self, round_idx: int):
+        """``(idx, rows)`` as numpy — the one blocking fetch of the
+        streaming plane, and it blocks on the CPU backend's stream,
+        not the accelerator's."""
+        with self._scope():
+            idx, rows = self._jit(self._key, np.int32(round_idx))
+            return jax.device_get((idx, rows))
+
+
+class StreamFeedProducer:
+    """The round-ahead feed pipeline: schedule replay → native row
+    gather → async ``device_put``, all on a background thread
+    (:class:`HostPrefetcher`, depth = the double buffer), so round
+    r+1's feed is built and its transfer dispatched while round r
+    computes. ``place_fn`` is the trainer's sharding-aware placement
+    (replicated over the mesh; multihost-safe via ``mesh._put``).
+
+    Feeds are strictly sequential from ``start_round``; a consumer that
+    observes a round mismatch (host state rewritten out from under the
+    producer — supervisor rollback, resume) must discard the producer
+    (``FederatedTrainer.invalidate_stream``) rather than reorder."""
+
+    def __init__(self, store: HostClientStore, *, key_data, key_impl,
+                 start_round: int, num_clients: int, k_online: int,
+                 local_steps: int, batch_size: int,
+                 place_fn: Optional[Callable] = None, depth: int = 2,
+                 timeout_s: float = 120.0):
+        self.store = store
+        self.start_round = int(start_round)
+        self.batch_size = batch_size
+        self.feed_rows = local_steps * batch_size
+        self._place = place_fn if place_fn is not None else jax.device_put
+        self._timeout_s = timeout_s
+        self._schedule = RoundSchedule(
+            key_data, key_impl, num_clients, k_online,
+            self.feed_rows, store.n_max, store.sizes)
+        self._expected = self.start_round
+        self.rounds_produced = 0
+        self._prefetcher = HostPrefetcher(self._produce, depth=depth,
+                                          name="stream-feed-producer")
+
+    def _produce(self, step: int):
+        round_idx = self.start_round + step
+        idx, rows = self._schedule(round_idx)
+        feed = self.store.pack(idx, rows, self.batch_size)
+        # device_put dispatches the H2D copy and returns immediately —
+        # the transfer rides behind the in-flight round's compute
+        placed = self._place(feed)
+        self.rounds_produced += 1
+        return round_idx, placed
+
+    def next_feed(self) -> RoundFeed:
+        round_idx, feed = self._prefetcher.next(timeout=self._timeout_s)
+        if round_idx != self._expected:
+            raise RuntimeError(
+                f"stream feed for round {round_idx} but round "
+                f"{self._expected} expected — the producer desynced "
+                "from the training state (rollback/resume without "
+                "invalidate_stream?)")
+        self._expected += 1
+        return feed
+
+    def close(self) -> bool:
+        """Stop the producer; True when the thread verifiably exited
+        (see ``HostPrefetcher.close``)."""
+        return self._prefetcher.close()

@@ -6,7 +6,9 @@ library is unavailable, so the Python-only path always works.
 """
 from __future__ import annotations
 
+import contextlib
 import ctypes
+import fcntl
 import os
 import queue
 import subprocess
@@ -22,15 +24,41 @@ _lib = None
 _lib_tried = False
 
 
-def _build_library() -> Optional[str]:
+def _lib_fresh() -> bool:
+    return (os.path.exists(_LIB_PATH)
+            and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC))
+
+
+def _build_library(run=subprocess.run) -> Optional[str]:
+    """Compile pipeline.cpp to the shared library, safely under races.
+
+    Two processes can reach here at once (an ElasticRunner relaunch
+    racing a worker, multi-process gloo tests), and a ``dlopen`` of a
+    half-written .so aborts the process — so the compiler writes to a
+    private temp path and the result lands via atomic ``os.replace``,
+    serialized by an exclusive per-path file lock. A process that waited
+    on the lock re-checks freshness and adopts the winner's build
+    instead of compiling twice. ``run`` is injectable for tests."""
+    lock_path = _LIB_PATH + ".lock"
+    tmp_path = f"{_LIB_PATH}.tmp.{os.getpid()}"
     try:
-        subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-o", _LIB_PATH, _SRC,
-             "-lpthread"],
-            check=True, capture_output=True, timeout=120)
-        return _LIB_PATH
+        with open(lock_path, "w") as lock_f:
+            fcntl.flock(lock_f.fileno(), fcntl.LOCK_EX)
+            try:
+                if _lib_fresh():
+                    return _LIB_PATH  # a racing builder finished first
+                run(["g++", "-O3", "-shared", "-fPIC", "-o", tmp_path,
+                     _SRC, "-lpthread"],
+                    check=True, capture_output=True, timeout=120)
+                os.replace(tmp_path, _LIB_PATH)
+                return _LIB_PATH
+            finally:
+                fcntl.flock(lock_f.fileno(), fcntl.LOCK_UN)
     except Exception:
         return None
+    finally:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp_path)  # a failed compile's partial output
 
 
 def load_library():
@@ -39,9 +67,7 @@ def load_library():
     if _lib is not None or _lib_tried:
         return _lib
     _lib_tried = True
-    fresh = (os.path.exists(_LIB_PATH)
-             and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC))
-    path = _LIB_PATH if fresh else _build_library()
+    path = _LIB_PATH if _lib_fresh() else _build_library()
     if path is None:
         return None
     try:
@@ -166,11 +192,13 @@ class HostPrefetcher:
     of the next work item with device compute (the role of the
     reference's DataLoader worker processes)."""
 
-    def __init__(self, produce_fn, depth: int = 2):
+    def __init__(self, produce_fn, depth: int = 2,
+                 name: str = "host-prefetcher"):
         self._produce = produce_fn
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread = threading.Thread(target=self._worker, daemon=True,
+                                        name=name)
         self._thread.start()
 
     def _worker(self):
@@ -179,13 +207,26 @@ class HostPrefetcher:
             try:
                 item = self._produce(step)
             except StopIteration:
-                self._q.put(None)
+                self._put(None)
                 return
             except BaseException as e:  # surface producer errors
-                self._q.put(e)
+                self._put(e)
                 return
-            self._q.put(item)
+            if not self._put(item):
+                return  # stopped while waiting for queue space
             step += 1
+
+    def _put(self, item) -> bool:
+        """Bounded-wait put that keeps observing the stop flag: a
+        worker parked on a full queue must exit promptly on close()
+        instead of blocking in ``Queue.put`` forever."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def next(self, timeout: float = 60.0):
         item = self._q.get(timeout=timeout)
@@ -193,10 +234,18 @@ class HostPrefetcher:
             raise item
         return item
 
-    def close(self):
+    def close(self, join_timeout: float = 5.0) -> bool:
+        """Stop the producer and drop queued items. Returns True when
+        the worker thread actually exited within the bounded join —
+        False means it is still finishing one in-flight produce call
+        (it observes the stop flag at its next put and exits on its
+        own; the thread is a daemon, so a drain with a deadline is
+        never blocked on it). Idempotent."""
         self._stop.set()
         try:
             while True:
                 self._q.get_nowait()
         except queue.Empty:
             pass
+        self._thread.join(timeout=join_timeout)
+        return not self._thread.is_alive()

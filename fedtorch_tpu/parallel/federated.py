@@ -29,6 +29,14 @@ Design (SURVEY.md §7):
   delta-as-grad with rank weights) and tree-summed over the client axis —
   a ``psum``-shaped reduction XLA lowers onto ICI. Every device applies
   the same server step (replicated-server semantics, fedavg.py:89-97).
+* Data planes (docs/performance.md "Streaming data plane"):
+  ``cfg.data.data_plane='device'`` (default) holds the full client
+  store in HBM and gathers the online rows in-program; ``'stream'``
+  keeps the store host-resident and the jitted round consumes a
+  host-packed per-round feed (``round_stream_fn``) built one round
+  ahead by ``data/streaming.py``. Both planes funnel into
+  ``_round_core`` and share ``round_row_plan``, so trajectories are
+  bitwise-identical across planes.
 * Fault tolerance (docs/robustness.md): ``cfg.fault`` drives a
   deterministic in-program chaos layer (client crashes masked out of
   aggregation with weight renormalization, straggler step cuts on the
@@ -40,6 +48,7 @@ Design (SURVEY.md §7):
 from __future__ import annotations
 
 import math
+import weakref
 from typing import Callable, Optional, Tuple
 
 import jax
@@ -58,7 +67,11 @@ from fedtorch_tpu.core.state import (
     tree_bytes, tree_sub, tree_where, tree_zeros_like,
 )
 from fedtorch_tpu.data.batching import (
-    ClientData, epoch_permutation, pad_client_axis, take_batch,
+    VAL_FOLD, ClientData, epoch_permutation, pad_client_axis,
+    round_row_plan, take_batch,
+)
+from fedtorch_tpu.data.streaming import (
+    HostClientStore, RoundFeed, StreamFeedProducer,
 )
 from fedtorch_tpu.models.common import ModelDef
 from fedtorch_tpu.ops.augment import augment_image_batch
@@ -137,6 +150,36 @@ class FederatedTrainer:
         # n_max, e.g. epoch-sync with several epochs per round).
         if gather_mode not in ("auto", "shard", "batch"):
             raise ValueError(f"unknown gather_mode {gather_mode!r}")
+        # the streaming data plane (docs/performance.md "Streaming data
+        # plane"): the client store stays host-resident and each round
+        # consumes a host-packed feed of the touched rows. The feed IS
+        # the 'batch' row plan, so 'shard' has no streamed meaning.
+        self.data_plane = cfg.data.data_plane
+        if self.data_plane == "stream":
+            why = None
+            if algorithm.needs_full_loss:
+                why = (f"{algorithm.name} evaluates each client's FULL "
+                       "local dataset every round (gather_mode='shard')")
+            elif (type(algorithm).participation
+                    is not FedAlgorithm.participation
+                    or type(algorithm).post_round_global
+                    is not FedAlgorithm.post_round_global):
+                why = (f"{algorithm.name} overrides participation/"
+                       "post_round_global with server-state-dependent "
+                       "logic the host feed builder cannot replay")
+            elif algorithm.needs_val_batch or val_data is not None:
+                why = ("per-client validation splits "
+                       "(cfg.federated.personal) are not streamed yet")
+            if why is not None:
+                raise ValueError(
+                    f"data_plane='stream' is unsupported here: {why}; "
+                    "use --data_plane device")
+            if gather_mode == "shard":
+                raise ValueError(
+                    "gather_mode='shard' moves whole client shards on "
+                    "device; the streaming plane packs rows host-side "
+                    "— use gather_mode 'auto' or 'batch'")
+            gather_mode = "batch"
         if gather_mode == "auto":
             gather_mode = "shard" if (
                 algorithm.needs_full_loss
@@ -179,11 +222,26 @@ class FederatedTrainer:
         # divisor (SURVEY.md §7 [cores, clients_per_core] layout)
         self.padded_clients = padded_client_count(self.num_clients,
                                                   self.mesh)
-        self.data = shard_clients(
-            pad_client_axis(data, self.padded_clients), self.mesh)
-        self.val_data = shard_clients(
-            pad_client_axis(val_data, self.padded_clients), self.mesh) \
-            if val_data is not None else None
+        if self.data_plane == "stream":
+            # HBM never sees the client store: it stays a host numpy
+            # array (population bounded by host RAM, not HBM) and each
+            # round receives only its double-buffered [k, K*B, ...]
+            # feed. Client STATE still shards over the mesh as usual —
+            # state is params-sized, data is the big thing.
+            self.host_store = HostClientStore(data)
+            self.data = None
+            self.val_data = None
+        else:
+            self.host_store = None
+            self.data = shard_clients(
+                pad_client_axis(data, self.padded_clients), self.mesh)
+            self.val_data = shard_clients(
+                pad_client_axis(val_data, self.padded_clients),
+                self.mesh) if val_data is not None else None
+        # lazily-started feed producer (stream plane only); see
+        # _next_stream_feed / invalidate_stream
+        self._stream: Optional[StreamFeedProducer] = None
+        self._stream_finalizer = None
         # trace-event instrumentation (utils.tracing): the sentinel
         # test asserts this program traces exactly once per trainer —
         # "static config => unchanged traced program" is the contract
@@ -192,6 +250,16 @@ class FederatedTrainer:
         self._round_jit = jax.jit(
             instrument_trace(self.trace_name, self.round_fn),
             donate_argnums=(0, 1))
+        # the streaming twin takes the per-round feed instead of the
+        # full data pytree; feed shapes are static, so it too traces
+        # exactly once (sentinel-pinned in tests/test_streaming.py)
+        self.stream_trace_name = \
+            f"federated.round_stream[{algorithm.name}]"
+        self._round_stream_jit = jax.jit(
+            instrument_trace(self.stream_trace_name,
+                             self.round_stream_fn),
+            donate_argnums=(0, 1)) if self.data_plane == "stream" \
+            else None
         self._rounds_jit: dict = {}  # num_rounds -> jitted scan driver
         # preemption stop-flag plumbing (robustness/preemption.py):
         # attach_stop_signal folds a cross-host-agreed stop flag into
@@ -228,7 +296,13 @@ class FederatedTrainer:
     # -- one communication round -----------------------------------------
     def round_fn(self, server: ServerState, clients: ClientState,
                  data: ClientData, val_data: Optional[ClientData] = None):
-        cfg, model, alg = self.cfg, self.model, self.algorithm
+        """Device-resident data plane: the full ``[C, n_max, ...]``
+        store is a program input and the round's online rows are
+        gathered IN-program (gather_mode 'batch'/'shard'). The
+        streaming twin (:meth:`round_stream_fn`) receives the same
+        rows as a host-packed feed; both funnel into
+        :meth:`_round_core`, so the two planes cannot diverge."""
+        alg = self.algorithm
         K, B, C = self.local_steps, self.batch_size, self.num_clients
         rng_round = jax.random.fold_in(server.rng, server.round)
         rng_sample, rng_train = jax.random.split(rng_round)
@@ -238,43 +312,17 @@ class FederatedTrainer:
         if idx is None:
             idx = participation_indices(rng_sample, C, self.k_online,
                                         server.round)
-        num_online_eff = num_online_effective(idx)
-        weights = alg.client_weights(server.aux, idx, num_online_eff,
-                                     jnp.take(data.sizes, idx))
-
-        # deterministic chaos schedule for this round (crash/straggler/
-        # poison masks over the online clients) — its own fold of the
-        # round key, so fault-free streams are untouched
-        flt = self.fault
-        plan = draw_chaos_plan(
-            jax.random.fold_in(rng_round, flt.chaos_salt),
-            self.k_online, flt) if self.chaos_on \
-            else no_chaos_plan(self.k_online)
-
-        # gather online-client state & data rows (the per-round new_group)
-        take = lambda t: jax.tree.map(lambda x: jnp.take(x, idx, axis=0), t)
-        on_clients = take(clients)
         on_sizes = jnp.take(data.sizes, idx)
         rngs = jax.random.split(rng_train, self.k_online)
         batch_mode = self.gather_mode == "batch"
 
-        # disjoint parent fold for the val stream: dropout uses folds
-        # [1, K] and augmentation 0x7FFFFFFF, so val lives at 0x7FFFFFFE
-        # (train's fold 0 is already outside the dropout range)
-        VAL_FOLD = 0x7FFFFFFE
-
-        def round_rows(rng_c, size, n_max, fold):
-            """The round's row plan: perm[(step*B + j) % size] for all
-            K*B (step, j) pairs — the epoch_permutation/take_batch batch
-            order (fold 0 = train stream, VAL_FOLD = val stream)."""
-            perm = epoch_permutation(jax.random.fold_in(rng_c, fold), size,
-                                     n_max)
-            return perm[jnp.arange(K * B) % jnp.maximum(size, 1)]
-
         if batch_mode:
-            # move only the touched rows: [k, K*B, ...]
-            rows = jax.vmap(lambda r, s: round_rows(
-                r, s, data.x.shape[1], 0))(rngs, on_sizes)
+            # move only the touched rows: [k, K*B, ...]. round_row_plan
+            # (data/batching.py) is the SHARED batch-order definition —
+            # the host feed packer calls the same function, which is
+            # what makes the streaming plane's bitwise parity hold.
+            rows = jax.vmap(lambda r, s: round_row_plan(
+                r, s, data.x.shape[1], K * B))(rngs, on_sizes)
             on_x = data.x[idx[:, None], rows]
             on_y = data.y[idx[:, None], rows]
         else:
@@ -291,8 +339,9 @@ class FederatedTrainer:
         if val_data is not None:
             on_vsizes = jnp.take(val_data.sizes, idx)
             if val_batch_mode:
-                vrows = jax.vmap(lambda r, s: round_rows(
-                    r, s, val_data.x.shape[1], VAL_FOLD))(rngs, on_vsizes)
+                vrows = jax.vmap(lambda r, s: round_row_plan(
+                    r, s, val_data.x.shape[1], K * B,
+                    VAL_FOLD))(rngs, on_vsizes)
                 on_vx = val_data.x[idx[:, None], vrows]
                 on_vy = val_data.y[idx[:, None], vrows]
             else:
@@ -303,14 +352,72 @@ class FederatedTrainer:
             on_vx, on_vy = on_x[:, :1], on_y[:, :1]
             on_vsizes = jnp.ones_like(on_sizes)
 
+        # the pre_round hook always sees each client's first B
+        # storage-order rows, independent of gather mode (so mode
+        # choice cannot change hook numerics, e.g. APFL's alpha)
+        pre_x = data.x[idx[:, None], jnp.arange(B)[None, :]]
+        pre_y = data.y[idx[:, None], jnp.arange(B)[None, :]]
+        return self._round_core(
+            server, clients, idx, on_x, on_y, on_vx, on_vy, on_sizes,
+            on_vsizes, pre_x, pre_y, rng_round, rngs,
+            batch_mode=batch_mode, val_batch_mode=val_batch_mode,
+            data=data)
+
+    def round_stream_fn(self, server: ServerState, clients: ClientState,
+                        feed: RoundFeed):
+        """Streaming data plane: the round program takes the host-packed
+        feed — the K online clients' pre-selected ``[k, K*B, ...]``
+        rows — instead of the full data pytree. The PRNG chain below is
+        byte-for-byte the device plane's (``rng_sample`` is drawn and
+        discarded: the host already replayed participation from it), so
+        dropout/augmentation/chaos streams line up and the trajectories
+        match the device plane bitwise (tests/test_streaming.py)."""
+        rng_round = jax.random.fold_in(server.rng, server.round)
+        _rng_sample, rng_train = jax.random.split(rng_round)
+        rngs = jax.random.split(rng_train, self.k_online)
+        # no streamed val plane (gated in __init__): mirror the device
+        # path's val_data-None placeholders exactly
+        on_vx, on_vy = feed.x[:, :1], feed.y[:, :1]
+        on_vsizes = jnp.ones_like(feed.sizes)
+        return self._round_core(
+            server, clients, feed.idx, feed.x, feed.y, on_vx, on_vy,
+            feed.sizes, on_vsizes, feed.pre_x, feed.pre_y, rng_round,
+            rngs, batch_mode=True, val_batch_mode=False)
+
+    def _round_core(self, server: ServerState, clients: ClientState,
+                    idx, on_x, on_y, on_vx, on_vy, on_sizes, on_vsizes,
+                    pre_x, pre_y, rng_round, rngs, *, batch_mode: bool,
+                    val_batch_mode: bool, data=None):
+        """The round program proper, data-plane agnostic: everything
+        after the online rows exist — local loops, chaos/guards,
+        aggregation, server step, state scatter, metrics. ``on_x`` is
+        either the packed rows [k, K*B, ...] (``batch_mode``) or whole
+        client shards [k, n_max, ...]. ``data`` (the full store) is
+        only threaded for ``post_round_global`` (DRFA's dual phase) —
+        the streaming plane, which gates such algorithms out, passes
+        None."""
+        cfg, model, alg = self.cfg, self.model, self.algorithm
+        K, B, C = self.local_steps, self.batch_size, self.num_clients
+        num_online_eff = num_online_effective(idx)
+        weights = alg.client_weights(server.aux, idx, num_online_eff,
+                                     on_sizes)
+
+        # deterministic chaos schedule for this round (crash/straggler/
+        # poison masks over the online clients) — its own fold of the
+        # round key, so fault-free streams are untouched
+        flt = self.fault
+        plan = draw_chaos_plan(
+            jax.random.fold_in(rng_round, flt.chaos_salt),
+            self.k_online, flt) if self.chaos_on \
+            else no_chaos_plan(self.k_online)
+
+        # gather online-client state (the per-round new_group)
+        take = lambda t: jax.tree.map(lambda x: jnp.take(x, idx, axis=0), t)
+        on_clients = take(clients)
+
         # cross-client pre-round hook (APFL adaptive alpha, apfl.py:119-123)
         on_lrs = jax.vmap(lambda e: lr_at(self.schedule, e))(
             on_clients.epoch)
-        # the hook always sees each client's first B storage-order rows,
-        # independent of gather mode (so mode choice cannot change hook
-        # numerics, e.g. APFL's adaptive alpha)
-        pre_x = data.x[idx[:, None], jnp.arange(B)[None, :]]
-        pre_y = data.y[idx[:, None], jnp.arange(B)[None, :]]
         on_aux0 = alg.pre_round(on_clients.aux, server=server, x=pre_x,
                                 y=pre_y, sizes=on_sizes, lr=on_lrs,
                                 rng=rng_round)
@@ -454,7 +561,7 @@ class FederatedTrainer:
             payloads, deltas, new_on_clients, (losses, accs) = \
                 self._fused_client_round(server, on_clients, on_x, on_y,
                                          on_sizes, weights, rngs,
-                                         plan.budget_scale)
+                                         plan.budget_scale, batch_mode)
         else:
             payloads, deltas, new_on_clients, (losses, accs) = jax.vmap(
                 client_round)(on_clients, on_x, on_y, on_vx, on_vy,
@@ -585,7 +692,7 @@ class FederatedTrainer:
 
     # -- fused client round (cfg.mesh.client_fusion='fused') --------------
     def _fused_client_round(self, server, on_clients, x, y, sizes,
-                            weights, rngs, budget_scale):
+                            weights, rngs, budget_scale, batch_mode):
         """``client_round`` for the fused client-axis strategy: one
         scan whose body computes ALL k online clients' forward/backward
         through the client-fused module (``feature_group_count=k``
@@ -601,10 +708,10 @@ class FederatedTrainer:
         cfg, model, alg = self.cfg, self.model, self.algorithm
         K, B, k = self.local_steps, self.batch_size, self.k_online
         flt = self.fault
-        batch_mode = self.gather_mode == "batch"
         server_params = server.params
         nb = jnp.ceil(sizes / B)  # [k] batches per local epoch
 
+        # lint: disable=FTL005 — batch_mode is a static Python bool
         if not batch_mode:
             perms = jax.vmap(
                 lambda r, s: epoch_permutation(
@@ -779,8 +886,63 @@ class FederatedTrainer:
         return {k: float(v) for k, v in jax.device_get(
             self.round_scalars_dev(clients, metrics)).items()}
 
+    # -- streaming feed plumbing (data_plane='stream') --------------------
+    def _next_stream_feed(self, server) -> RoundFeed:
+        """Pop the next round's host-packed feed, (re)starting the
+        producer from the LIVE device state on first use or after
+        :meth:`invalidate_stream`. The (rng, round) fetch is one
+        batched ``device_get`` paid only at (re)start — steady-state
+        rounds consume prefetched feeds without touching the device
+        stream, and the producer stays >= 1 round ahead."""
+        if self._stream is None:
+            key_data, round0 = jax.device_get(
+                (jax.random.key_data(server.rng), server.round))
+            # place_fn must NOT close over self: the producer thread
+            # holds it, and a reference back to the trainer would keep
+            # a dropped trainer (and its jit caches) alive forever
+            mesh = self.mesh
+            self._stream = StreamFeedProducer(
+                self.host_store, key_data=key_data,
+                key_impl=jax.random.key_impl(server.rng),
+                start_round=int(round0), num_clients=self.num_clients,
+                k_online=self.k_online, local_steps=self.local_steps,
+                batch_size=self.batch_size,
+                place_fn=lambda t: replicate(t, mesh))
+            # leak guard: a trainer dropped WITHOUT invalidate_stream
+            # must not orphan the producer thread (it would pin the
+            # host store + the placed feeds for the process lifetime)
+            self._stream_finalizer = weakref.finalize(
+                self, StreamFeedProducer.close, self._stream)
+        return self._stream.next_feed()
+
+    def invalidate_stream(self) -> None:
+        """Drop the feed producer and every prefetched round. Call
+        whenever host-visible training state stops matching the
+        producer's replay — supervisor rollback/reseed, checkpoint
+        resume into an existing trainer, preemption drain, end of run.
+        The next streamed round re-syncs from the live device state.
+        No-op on the device data plane (and before the first streamed
+        round)."""
+        if getattr(self, "_stream", None) is not None:
+            if self._stream_finalizer is not None:
+                self._stream_finalizer.detach()
+                self._stream_finalizer = None
+            self._stream.close()
+            self._stream = None
+
     # -- host-side round loop ---------------------------------------------
     def run_round(self, server, clients):
+        """One communication round. STREAM-PLANE CONTRACT: each call
+        consumes the producer's next sequential feed, so calls must
+        advance the state monotonically (the returned server carries
+        round+1). Replaying a round on saved/copied state — legal and
+        idempotent on the device plane — requires
+        :meth:`invalidate_stream` first so the producer re-syncs to
+        the replayed (rng, round); the supervisor's retry path and the
+        CLI resume path already do this."""
+        if self.data_plane == "stream":
+            return self._round_stream_jit(server, clients,
+                                          self._next_stream_feed(server))
         return self._round_jit(server, clients, self.data, self.val_data)
 
     def run_rounds(self, server, clients, num_rounds: int):
@@ -792,7 +954,21 @@ class FederatedTrainer:
         ``num_rounds`` calls of :meth:`run_round` to float tolerance
         (same ops; the scan body is a separate XLA compilation, which
         may reassociate float math). One jitted driver is cached per
-        distinct ``num_rounds``."""
+        distinct ``num_rounds``.
+
+        This is the DEVICE-resident fast path: the scan closes over
+        the full data pytree in HBM. The streaming plane necessarily
+        dispatches per round — the host must be in the loop to hand
+        each round its feed (and that per-round gap is what the
+        round-ahead prefetch hides) — so it refuses here instead of
+        silently changing the dispatch granularity."""
+        if self.data_plane == "stream":
+            raise RuntimeError(
+                "run_rounds scans the round program over device-resident "
+                "data (single-dispatch fast path); data_plane='stream' "
+                "dispatches per round so the host can overlap the next "
+                "feed — call run_round in a loop (docs/performance.md "
+                "'Streaming data plane')")
         if num_rounds not in self._rounds_jit:
             def rounds_fn(server, clients, data, val_data):
                 def body(carry, _):

@@ -219,6 +219,12 @@ class RoundSupervisor:
 
             self.stats.rollbacks += 1
             server, clients = self._restore(snapshot)
+            # the streaming data plane replays (rng, round) host-side;
+            # a rollback (and the reseed below) rewrites both out from
+            # under its prefetched feeds — drop them so the retry
+            # re-syncs from the restored state (getattr: fakes/mocks
+            # in tests need not implement the streaming surface)
+            getattr(self.trainer, "invalidate_stream", lambda: None)()
             self._log(f"supervisor: round {round_idx} attempt "
                       f"{attempt + 1}/{flt.max_retries + 1} diverged "
                       f"({why}); rolled back")

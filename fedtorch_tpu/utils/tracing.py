@@ -173,3 +173,30 @@ def device_memory_stats() -> dict:
         except Exception:
             pass
     return stats
+
+
+def live_buffer_summary() -> dict:
+    """Live ``jax.Array`` accounting: total ADDRESSABLE bytes (each
+    replicated copy counted — the buffers a device actually holds) and
+    a per-(shape, dtype) breakdown.
+
+    ``device_memory_stats`` is allocator-dependent and returns nothing
+    on the CPU backend, so the streaming-residency contract ("the
+    device holds the double-buffered feed, not the client store" —
+    tests/test_streaming.py, scripts/stream_bench.py) is asserted
+    against THIS view, which works on every platform: what the program
+    still holds references to, shape by shape."""
+    by_shape: Dict[str, int] = {}
+    total = 0
+    for a in jax.live_arrays():
+        try:
+            n = sum(int(s.data.nbytes) for s in a.addressable_shards)
+        except Exception:
+            try:
+                n = int(a.size) * a.dtype.itemsize
+            except Exception:
+                continue
+        key = f"{tuple(a.shape)}:{a.dtype}"
+        by_shape[key] = by_shape.get(key, 0) + n
+        total += n
+    return {"total_bytes": total, "by_shape": by_shape}

@@ -1,0 +1,189 @@
+"""Streaming-data-plane A/B: `data_plane='device'` vs `'stream'`.
+
+Measures, per plane, on the north-star-shaped workload:
+
+* steady-state round wall-time (fetch-synced — bench_timing.sync);
+* bytes moved host→device per round (stream: one packed feed; device:
+  zero steady-state — the store is resident, that residency being the
+  thing the stream plane trades away);
+* device residency (utils.tracing.live_buffer_summary — works on every
+  platform — plus device_memory_stats where the allocator reports);
+* retraces during the timed window (the recompilation sentinel: the
+  streamed round program must trace exactly once, in warmup);
+* bitwise parity of the two planes' server params after the A/B.
+
+The acceptance bar (ISSUE 5): steady-state streamed round wall-time
+within 10% of device-resident when feed-build+transfer < round compute
+— i.e. the round-ahead prefetch actually hides the transfer.
+
+Writes STREAM_AB.json (STREAM_AB_PATH overrides, for the test smoke).
+STREAM_BENCH_SMOKE=1 shrinks the workload for CPU CI.
+
+Run:  python scripts/stream_bench.py
+"""
+from __future__ import annotations
+
+import gc
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+
+from fedtorch_tpu.utils import enable_compile_cache, \
+    honor_platform_env  # noqa: E402
+
+honor_platform_env()  # the site hook may pin jax_platforms to the proxy
+enable_compile_cache()
+
+from bench_timing import sync  # noqa: E402
+from fedtorch_tpu.algorithms import make_algorithm  # noqa: E402
+from fedtorch_tpu.config import (  # noqa: E402
+    DataConfig, ExperimentConfig, FederatedConfig, MeshConfig, ModelConfig,
+    OptimConfig, TrainConfig,
+)
+from fedtorch_tpu.data.batching import stack_partitions  # noqa: E402
+from fedtorch_tpu.data.streaming import feed_nbytes  # noqa: E402
+from fedtorch_tpu.models import define_model  # noqa: E402
+from fedtorch_tpu.parallel import FederatedTrainer  # noqa: E402
+from fedtorch_tpu.utils.tracing import (  # noqa: E402
+    RecompilationSentinel, device_memory_stats, live_buffer_summary,
+)
+
+SMOKE = os.environ.get("STREAM_BENCH_SMOKE") == "1"
+# smoke: tiny MLP on MNIST-shaped synthetic rows; full: the north-star
+# resnet20/cifar10-shaped workload (bench.py's config, per-round mode)
+NUM_CLIENTS = 16 if SMOKE else 100
+BATCH = 8 if SMOKE else 50
+K = 2 if SMOKE else 10
+SPC = 64 if SMOKE else 250
+ROUNDS = 3 if SMOKE else 20
+ONLINE = 0.25 if SMOKE else 0.1
+ARCH = "mlp" if SMOKE else "resnet20"
+DATASET = "mnist" if SMOKE else "cifar10"
+FEAT_SHAPE = (784,) if SMOKE else (32, 32, 3)
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def build(plane: str):
+    cfg = ExperimentConfig(
+        data=DataConfig(dataset=DATASET, batch_size=BATCH,
+                        data_plane=plane, augment=False),
+        federated=FederatedConfig(
+            federated=True, num_clients=NUM_CLIENTS,
+            online_client_rate=ONLINE, algorithm="fedavg",
+            sync_type="local_step"),
+        model=ModelConfig(arch=ARCH, mlp_num_layers=2,
+                          mlp_hidden_size=128),
+        optim=OptimConfig(lr=0.1, in_momentum=not SMOKE),
+        train=TrainConfig(local_step=K),
+        mesh=MeshConfig(),
+    ).finalize()
+    rng = np.random.RandomState(0)
+    feats = rng.randn(NUM_CLIENTS * SPC,
+                      *FEAT_SHAPE).astype(np.float32)
+    labels = rng.randint(0, 10, NUM_CLIENTS * SPC)
+    parts = [np.arange(i * SPC, (i + 1) * SPC)
+             for i in range(NUM_CLIENTS)]
+    data = stack_partitions(feats, labels, parts)
+    model = define_model(cfg, batch_size=BATCH)
+    return FederatedTrainer(cfg, model, make_algorithm(cfg), data)
+
+
+def timed(tr):
+    server, clients = tr.init_state(jax.random.key(0))
+    server, clients, _ = tr.run_round(server, clients)
+    sync(server.params)  # compile + first feed fully drained
+    residency = live_buffer_summary()
+    hbm = device_memory_stats()
+    with RecompilationSentinel() as sentinel:
+        t0 = time.perf_counter()
+        for _ in range(ROUNDS):
+            server, clients, _ = tr.run_round(server, clients)
+        sync(server.params)
+        dt = (time.perf_counter() - t0) / ROUNDS
+    retraces = sum(sentinel.counts.values())
+    params = jax.device_get(server.params)
+    tr.invalidate_stream()
+    return dt, residency, hbm, retraces, params
+
+
+def main():
+    devs = jax.devices()
+    log(f"devices: {len(devs)} x {devs[0].platform}")
+    out = {
+        "platform": f"{len(devs)} x {devs[0].device_kind}",
+        "config": {"clients": NUM_CLIENTS, "batch": BATCH, "K": K,
+                   "rows_per_client": SPC, "arch": ARCH,
+                   "rounds_timed": ROUNDS, "smoke": SMOKE},
+        "modes": {},
+    }
+    finals = {}
+    for plane in ("device", "stream"):
+        gc.collect()
+        base_bytes = live_buffer_summary()["total_bytes"]
+        tr = build(plane)
+        feed_bytes = 0
+        if plane == "stream":
+            # one packed feed = the unit of steady-state H2D traffic
+            # AND of device data residency (x the double buffer)
+            kb = tr.local_steps * tr.batch_size
+            feed_bytes = feed_nbytes(tr.host_store.pack(
+                np.arange(tr.k_online),
+                np.zeros((tr.k_online, kb), np.int64), tr.batch_size))
+        dt, residency, hbm, retraces, params = timed(tr)
+        store_mb = tr.host_store.nbytes / 2**20 if plane == "stream" \
+            else sum(np.asarray(leaf).nbytes for leaf in
+                     jax.tree.leaves(tr.data.x)) / 2**20
+        out["modes"][plane] = {
+            "ms_per_round": round(dt * 1e3, 2),
+            "h2d_mb_per_round": round(feed_bytes / 2**20, 3)
+            if plane == "stream" else 0.0,
+            "client_store_mb": round(store_mb, 2),
+            "live_device_bytes_after_warmup": max(
+                residency["total_bytes"] - base_bytes, 0),
+            "retraces_during_timed_rounds": retraces,
+        }
+        if hbm:
+            peak = max(v.get("peak_bytes_in_use") or 0
+                       for v in hbm.values())
+            out["modes"][plane]["peak_hbm_bytes"] = int(peak)
+        finals[plane] = params
+        log(f"{plane:6s}: {dt*1e3:8.2f} ms/round, "
+            f"{residency['total_bytes']/2**20:7.1f} MB live on device, "
+            f"{retraces} retraces")
+        del tr
+    d, s = (out["modes"]["device"]["ms_per_round"],
+            out["modes"]["stream"]["ms_per_round"])
+    out["stream_over_device_walltime"] = round(s / d, 3)
+    out["overlap_within_10pct"] = bool(s <= 1.10 * d)
+    # finals hold HOST numpy (device_get in timed()) — no device sync
+    # lint: disable=FTL001 — operands already fetched to host
+    diffs = [float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+             for a, b in zip(jax.tree.leaves(finals["device"]),
+                             jax.tree.leaves(finals["stream"]))]
+    max_diff = max(diffs)  # plain Python floats from the line above
+    out["parity_bitwise"] = max_diff == 0.0
+    out["parity_max_abs_diff"] = max_diff
+    out["residency_ratio_stream_over_device"] = round(
+        out["modes"]["stream"]["live_device_bytes_after_warmup"]
+        / max(out["modes"]["device"]["live_device_bytes_after_warmup"],
+              1), 4)
+    path = os.environ.get("STREAM_AB_PATH") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "STREAM_AB.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

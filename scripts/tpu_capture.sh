@@ -11,6 +11,11 @@
 #   bench            bench.py                     -> TPU_BENCH_CAPTURE.json
 #   bench-unroll     BENCH_SCAN_UNROLL=4 bench.py (unroll A/B)
 #   bench-dispatch   BENCH_SINGLE_DISPATCH=0      (dispatch A/B)
+#   bench-streaming  BENCH_STREAMING=1 bench.py   (streaming-plane A/B
+#                        side: host store + round-ahead prefetch)
+#   stream           scripts/stream_bench.py      -> STREAM_AB.json
+#                        (device vs stream wall-time + bytes moved +
+#                         residency + retrace count on the real chip)
 #   conv-ab          BENCH_CONV_IMPL=matmul|conv  (lowering A/B, both)
 #   zoo              scripts/tpu_zoo_check.py     -> TPU_ZOO.json
 #   pallas           scripts/pallas_tpu_check.py  -> PALLAS_TPU.json
@@ -46,8 +51,8 @@ TRIES="${TPU_CAPTURE_WAIT_TRIES:-90}"   # ~6 h of patience by default
 # mfu leads: round 6 is the utilization round — the fused-vs-base A/B
 # and the first-ever on-chip traces are the highest-value capture if
 # the relay wedges mid-list
-DEFAULT_STEPS="mfu bench-dispatch bench-unroll bench zoo pallas \
-flash-train vmap baseline"
+DEFAULT_STEPS="mfu stream bench-streaming bench-dispatch bench-unroll \
+bench zoo pallas flash-train vmap baseline"
 STEPS="${*:-$DEFAULT_STEPS}"
 
 echo "[tpu_capture] waiting for the relay (up to ${TRIES}x120s probes)"
@@ -63,6 +68,8 @@ for step in $STEPS; do
         bench)          run python bench.py ;;
         bench-unroll)   run env BENCH_SCAN_UNROLL=4 python bench.py ;;
         bench-dispatch) run env BENCH_SINGLE_DISPATCH=0 python bench.py ;;
+        bench-streaming) run env BENCH_STREAMING=1 python bench.py ;;
+        stream)         run python scripts/stream_bench.py ;;
         conv-ab)        run env BENCH_CONV_IMPL=matmul python bench.py
                         run env BENCH_CONV_IMPL=conv python bench.py ;;
         zoo)            run python scripts/tpu_zoo_check.py ;;

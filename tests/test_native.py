@@ -12,6 +12,80 @@ def test_library_builds():
     assert native_available(), "g++ build of pipeline.cpp failed"
 
 
+class TestBuildRace:
+    """_build_library must never leave a half-written .so where a
+    racing process could dlopen it: compile to a temp path, land via
+    atomic rename, serialized by a per-path file lock."""
+
+    def _patch_paths(self, tmp_path, monkeypatch):
+        import fedtorch_tpu.native.host_pipeline as hp
+        src = tmp_path / "src.cpp"
+        src.write_text("// fake source")
+        monkeypatch.setattr(hp, "_SRC", str(src))
+        monkeypatch.setattr(hp, "_LIB_PATH", str(tmp_path / "lib.so"))
+        return hp, tmp_path / "lib.so"
+
+    def test_never_compiles_in_place_and_no_tmp_residue(
+            self, tmp_path, monkeypatch):
+        hp, lib = self._patch_paths(tmp_path, monkeypatch)
+        outs = []
+
+        def fake_run(cmd, **kw):
+            out = cmd[cmd.index("-o") + 1]
+            assert out != str(lib)  # in-place write = the race bug
+            outs.append(out)
+            with open(out, "wb") as f:
+                f.write(b"SO")
+
+        assert hp._build_library(run=fake_run) == str(lib)
+        assert lib.read_bytes() == b"SO"
+        assert len(outs) == 1
+        residue = [p for p in tmp_path.iterdir()
+                   if p.name.startswith("lib.so.tmp")]
+        assert residue == []
+
+    def test_concurrent_builders_compile_once(self, tmp_path,
+                                              monkeypatch):
+        import threading
+        import time
+        hp, lib = self._patch_paths(tmp_path, monkeypatch)
+        compiles = []
+
+        def slow_run(cmd, **kw):
+            compiles.append(cmd)
+            time.sleep(0.2)  # hold the lock long enough to collide
+            with open(cmd[cmd.index("-o") + 1], "wb") as f:
+                f.write(b"SO")
+
+        results = []
+        threads = [threading.Thread(
+            target=lambda: results.append(
+                hp._build_library(run=slow_run))) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # the loser waited on the lock, re-checked freshness, and
+        # adopted the winner's build instead of compiling again
+        assert results == [str(lib), str(lib)]
+        assert len(compiles) == 1
+        assert lib.read_bytes() == b"SO"
+
+    def test_failed_compile_leaves_nothing(self, tmp_path, monkeypatch):
+        hp, lib = self._patch_paths(tmp_path, monkeypatch)
+
+        def broken_run(cmd, **kw):
+            with open(cmd[cmd.index("-o") + 1], "wb") as f:
+                f.write(b"PART")  # partial output before the failure
+            raise RuntimeError("compiler died")
+
+        assert hp._build_library(run=broken_run) is None
+        assert not lib.exists()
+        residue = [p for p in tmp_path.iterdir()
+                   if p.name.startswith("lib.so.tmp")]
+        assert residue == []
+
+
 def test_seeded_perm_valid_and_deterministic():
     p1 = seeded_permutation(1000, seed=42)
     p2 = seeded_permutation(1000, seed=42)

@@ -382,7 +382,7 @@ class TestLifecycleConfig:
 
 
 # -- run_experiment lifecycle ------------------------------------------------
-def _cli_cfg(run_dir, rounds=3, async_save=False):
+def _cli_cfg(run_dir, rounds=3, async_save=False, extra=()):
     from fedtorch_tpu.cli import args_to_config, build_parser
     argv = [
         "--federated", "true", "-d", "synthetic", "-a",
@@ -393,6 +393,7 @@ def _cli_cfg(run_dir, rounds=3, async_save=False):
         "--debug", "false", "--run_dir", run_dir]
     if async_save:
         argv.append("--async_checkpoint")
+    argv.extend(extra)
     return args_to_config(build_parser().parse_args(argv))
 
 
@@ -418,6 +419,70 @@ class TestRunExperimentLifecycle:
         # the loop's finally restored the pre-run handler — library
         # callers must not inherit a swallowing SIGTERM handler
         assert signal.getsignal(signal.SIGTERM) is before
+
+    def test_stream_drain_leaves_resumable_checkpoint(self, tmp_path):
+        """Streaming data plane × preemption: the SIGTERM lands while
+        round-ahead prefetches are in flight BY CONSTRUCTION (the
+        producer runs up to 2 rounds ahead of the loop). The drain
+        must still write a final checkpoint, stop the feed-producer
+        thread, and the resumed run must continue the exact streamed
+        trajectory (bitwise vs an uninterrupted run)."""
+        from fedtorch_tpu.cli import run_experiment
+        run_dir = str(tmp_path / "run")
+        stream = ("--data_plane", "stream")
+        cfg = _cli_cfg(run_dir, rounds=6, extra=stream)
+
+        def cb(r, trainer, server, clients, metrics):
+            if r == 1:
+                # prefetch pipeline is live right now
+                assert any(t.name == "stream-feed-producer"
+                           and t.is_alive()
+                           for t in threading.enumerate())
+                os.kill(os.getpid(), signal.SIGTERM)
+
+        res = run_experiment(cfg, round_callback=cb)
+        assert res["preempted"] and res["preempted_at_round"] == 2
+        assert read_checkpoint_round(run_dir) == 3
+        # the drain stopped the producer (no thread left blocked on
+        # the feed queue across the exit-75 boundary)
+        assert not any(t.name == "stream-feed-producer" and t.is_alive()
+                       for t in threading.enumerate())
+
+        # relaunch-with---resume leg: rounds 3..5 complete
+        res2 = run_experiment(
+            _cli_cfg(run_dir, rounds=6,
+                     extra=stream + ("--resume", run_dir)))
+        assert "preempted" not in res2
+        assert read_checkpoint_round(run_dir) == 6
+
+        # stitched trajectory == uninterrupted streamed run, bitwise
+        ref_dir = str(tmp_path / "ref")
+        run_experiment(_cli_cfg(ref_dir, rounds=6, extra=stream))
+        from fedtorch_tpu.algorithms import make_algorithm
+        from fedtorch_tpu.data import build_federated_data
+        from fedtorch_tpu.models import define_model
+        from fedtorch_tpu.parallel import FederatedTrainer
+        from fedtorch_tpu.utils import maybe_resume
+
+        def final_server(d):
+            data = build_federated_data(cfg)
+            model = define_model(cfg, batch_size=cfg.data.batch_size)
+            tr = FederatedTrainer(cfg, model, make_algorithm(cfg),
+                                  data.train)
+            server, clients = tr.init_state(
+                jax.random.key(cfg.train.manual_seed))
+            server, _, _, resumed = maybe_resume(d, server, clients,
+                                                 cfg)
+            assert resumed
+            return server
+
+        a, b = final_server(run_dir), final_server(ref_dir)
+        assert int(jax.device_get(a.round)) == 6
+        for la, lb in zip(jax.tree.leaves(a.params),
+                          jax.tree.leaves(b.params)):
+            import numpy as np
+            np.testing.assert_array_equal(np.asarray(la),
+                                          np.asarray(lb))
 
     def test_raising_round_loop_lands_pending_async_checkpoint(
             self, tmp_path, monkeypatch):

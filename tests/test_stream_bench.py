@@ -1,0 +1,38 @@
+"""Slow-lane smoke for the streaming A/B bench (scripts/stream_bench.py
+→ STREAM_AB.json): the capture must run end to end on the CPU mesh,
+report bitwise parity, zero steady-state retraces, and a well-formed
+record — so the on-chip capture (tpu_capture.sh `stream` step) cannot
+be the first time the script ever executes."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_stream_bench_smoke(tmp_path):
+    out_path = str(tmp_path / "STREAM_AB.json")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               STREAM_BENCH_SMOKE="1", STREAM_AB_PATH=out_path)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "stream_bench.py")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    with open(out_path) as f:
+        report = json.load(f)
+    assert set(report["modes"]) == {"device", "stream"}
+    # the streamed program traced once (in warmup): the timed window
+    # must be retrace-free on BOTH planes
+    for mode in report["modes"].values():
+        assert mode["retraces_during_timed_rounds"] == 0
+        assert mode["ms_per_round"] > 0
+    # stream moves a feed per round; device moves nothing steady-state
+    assert report["modes"]["stream"]["h2d_mb_per_round"] > 0
+    assert report["modes"]["device"]["h2d_mb_per_round"] == 0
+    # the two planes trained the same model
+    assert report["parity_bitwise"] is True

@@ -1,0 +1,378 @@
+"""Streaming data plane (``cfg.data.data_plane='stream'``): bitwise
+parity with the device plane (FedAvg + SCAFFOLD, chaos on and off, both
+sync modes), device residency bounded by the double-buffered feed,
+exactly-once tracing of the streamed round program, native-vs-numpy
+feed-packer parity, and the host-replay lifecycle (invalidate/resume,
+supervisor rollback resync)."""
+import dataclasses
+import gc
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from fedtorch_tpu.algorithms import make_algorithm
+from fedtorch_tpu.config import (
+    DataConfig, ExperimentConfig, FaultConfig, FederatedConfig,
+    ModelConfig, OptimConfig, TrainConfig,
+)
+from fedtorch_tpu.data import build_federated_data
+from fedtorch_tpu.data.batching import ClientData
+from fedtorch_tpu.data.streaming import (
+    HostClientStore, RoundFeed, feed_nbytes,
+)
+from fedtorch_tpu.models import define_model
+from fedtorch_tpu.parallel import FederatedTrainer
+from fedtorch_tpu.utils.tracing import (
+    RecompilationSentinel, live_buffer_summary,
+)
+
+CHAOS = {"client_drop_rate": 0.3, "straggler_rate": 0.3,
+         "nan_inject_rate": 0.3, "guard_updates": True}
+
+
+def make_cfg(plane, algorithm="fedavg", fault_kw=None, sync="local_step",
+             num_epochs_per_comm=1, local_step=5, batch_size=16,
+             num_clients=8, online_rate=0.5, **fed_kw):
+    return ExperimentConfig(
+        data=DataConfig(dataset="synthetic", synthetic_dim=20,
+                        batch_size=batch_size, synthetic_alpha=0.5,
+                        synthetic_beta=0.5, data_plane=plane),
+        federated=FederatedConfig(
+            federated=True, num_clients=num_clients,
+            online_client_rate=online_rate, algorithm=algorithm,
+            sync_type=sync, num_epochs_per_comm=num_epochs_per_comm,
+            **fed_kw),
+        model=ModelConfig(arch="logistic_regression"),
+        optim=OptimConfig(lr=0.3, weight_decay=0.0),
+        train=TrainConfig(local_step=local_step),
+        fault=FaultConfig(**(fault_kw or {})),
+    ).finalize()
+
+
+def build(plane, **kw):
+    cfg = make_cfg(plane, **kw)
+    data = build_federated_data(cfg)
+    model = define_model(cfg, batch_size=cfg.data.batch_size)
+    return FederatedTrainer(cfg, model, make_algorithm(cfg), data.train)
+
+
+def assert_trees_equal(a, b):
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+# -- bitwise parity with the device plane ------------------------------------
+@pytest.mark.parametrize("algorithm,fault_kw", [
+    ("fedavg", None),
+    ("fedavg", CHAOS),          # chaos + guards ride the same streams
+    ("scaffold", None),
+    ("scaffold", CHAOS),
+])
+def test_stream_matches_device_bitwise(algorithm, fault_kw):
+    """Server params, full client state (incl. SCAFFOLD control
+    variates), and metrics must match the device plane BITWISE over
+    multiple rounds — the acceptance contract of the streaming plane."""
+    t_dev = build("device", algorithm=algorithm, fault_kw=fault_kw)
+    t_str = build("stream", algorithm=algorithm, fault_kw=fault_kw)
+    assert t_str.data is None and t_str.host_store is not None
+    s1, c1 = t_dev.init_state(jax.random.key(3))
+    s2, c2 = t_str.init_state(jax.random.key(3))
+    for _ in range(3):
+        s1, c1, m1 = t_dev.run_round(s1, c1)
+        s2, c2, m2 = t_str.run_round(s2, c2)
+    assert_trees_equal((s1.params, s1.aux, c1), (s2.params, s2.aux, c2))
+    assert_trees_equal(m1, m2)
+    t_str.invalidate_stream()
+
+
+def test_stream_matches_device_shard_path_epoch_sync():
+    """Epoch-sync device mode auto-resolves gather_mode='shard'; the
+    streamed rows (always the 'batch' plan) must still match it
+    bitwise — the row plan IS the shard-mode batch order flattened."""
+    t_dev = build("device", sync="epoch", num_epochs_per_comm=2)
+    t_str = build("stream", sync="epoch", num_epochs_per_comm=2)
+    assert t_dev.gather_mode == "shard"
+    assert t_str.gather_mode == "batch"
+    s1, c1 = t_dev.init_state(jax.random.key(7))
+    s2, c2 = t_str.init_state(jax.random.key(7))
+    for _ in range(2):
+        s1, c1, m1 = t_dev.run_round(s1, c1)
+        s2, c2, m2 = t_str.run_round(s2, c2)
+    assert_trees_equal((s1.params, c1.params), (s2.params, c2.params))
+    t_str.invalidate_stream()
+
+
+def test_stream_resyncs_after_invalidate_mid_run():
+    """Dropping the producer mid-run (the supervisor-rollback /
+    resume-into-live-trainer path) must re-sync from device state and
+    continue the exact trajectory."""
+    t_dev = build("device")
+    t_str = build("stream")
+    s1, c1 = t_dev.init_state(jax.random.key(0))
+    s2, c2 = t_str.init_state(jax.random.key(0))
+    for r in range(4):
+        s1, c1, _ = t_dev.run_round(s1, c1)
+        s2, c2, _ = t_str.run_round(s2, c2)
+        if r == 1:
+            t_str.invalidate_stream()  # all prefetched feeds dropped
+    assert_trees_equal(s1.params, s2.params)
+    t_str.invalidate_stream()
+
+
+# -- producer behavior -------------------------------------------------------
+def test_producer_prefetches_ahead_and_drains():
+    t = build("stream", local_step=2, batch_size=8, online_rate=0.25)
+    server, clients = t.init_state(jax.random.key(0))
+    server, clients, _ = t.run_round(server, clients)
+    jax.block_until_ready(server.params)
+    assert any(th.name == "stream-feed-producer"
+               for th in threading.enumerate())
+    # double-buffered: by the time round 0 finished, later rounds'
+    # feeds were (or are being) produced ahead of consumption
+    assert t._stream.rounds_produced >= 2
+    t.invalidate_stream()
+    assert not any(th.name == "stream-feed-producer" and th.is_alive()
+                   for th in threading.enumerate())
+    assert t._stream is None
+
+
+def test_dropped_trainer_does_not_leak_producer():
+    """A stream-plane trainer dropped WITHOUT invalidate_stream must
+    not orphan the producer thread (which would pin the host store
+    and the placed feeds for the rest of the process): the weakref
+    finalizer closes the stream when the trainer is collected."""
+    import time
+    t = build("stream", local_step=2, batch_size=8, online_rate=0.25)
+    server, clients = t.init_state(jax.random.key(0))
+    server, clients, _ = t.run_round(server, clients)
+    jax.block_until_ready(server.params)
+    assert any(th.name == "stream-feed-producer" and th.is_alive()
+               for th in threading.enumerate())
+    del t, server, clients
+    gc.collect()
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        if not any(th.name == "stream-feed-producer" and th.is_alive()
+                   for th in threading.enumerate()):
+            break
+        time.sleep(0.1)
+    assert not any(th.name == "stream-feed-producer" and th.is_alive()
+                   for th in threading.enumerate())
+
+
+def test_stream_round_traces_exactly_once():
+    """The recompilation sentinel on the streamed round program: feed
+    shapes are static, so 4 rounds = 1 trace (the 'static config =>
+    unchanged traced program' contract, docs/static_analysis.md)."""
+    t = build("stream")
+    server, clients = t.init_state(jax.random.key(1))
+    with RecompilationSentinel() as s:
+        for _ in range(4):
+            server, clients, _ = t.run_round(server, clients)
+        jax.block_until_ready(server.params)
+    s.assert_traces(t.stream_trace_name, expected=1)
+    t.invalidate_stream()
+
+
+# -- device residency --------------------------------------------------------
+def test_device_holds_feed_not_store():
+    """The residency contract: under 'stream' no device array holds the
+    full [C, n_max, ...] client store — only feed-sized buffers (at
+    most the prefetch depth + the round in flight) — and total live
+    device bytes drop below the device plane's."""
+    kw = dict(local_step=2, batch_size=8, online_rate=0.25)
+
+    gc.collect()
+    base = live_buffer_summary()["total_bytes"]
+    t_dev = build("device", **kw)
+    server, clients = t_dev.init_state(jax.random.key(0))
+    for _ in range(2):
+        server, clients, _ = t_dev.run_round(server, clients)
+    jax.block_until_ready(server.params)
+    summary = live_buffer_summary()
+    dev_bytes = summary["total_bytes"] - base
+    store_shape = tuple(t_dev.data.x.shape)
+    store_key = f"{store_shape}:{t_dev.data.x.dtype}"
+    assert store_key in summary["by_shape"]  # full store is resident
+    del t_dev, server, clients
+    gc.collect()
+
+    base = live_buffer_summary()["total_bytes"]
+    t_str = build("stream", **kw)
+    server, clients = t_str.init_state(jax.random.key(0))
+    for _ in range(2):
+        server, clients, _ = t_str.run_round(server, clients)
+    jax.block_until_ready(server.params)
+    summary = live_buffer_summary()
+    str_bytes = summary["total_bytes"] - base
+    # the full client store must NOT be resident on device...
+    assert store_key not in summary["by_shape"]
+    # ...only packed feeds: [k, K*B, ...], bounded by the double
+    # buffer (queue depth 2) + the feed in flight + one being placed
+    k, rows = t_str.k_online, t_str.local_steps * t_str.batch_size
+    feed_key = f"{(k, rows, 20)}:float32"
+    n_feeds = summary["by_shape"].get(feed_key, 0) \
+        / (k * rows * 20 * 4 * jax.device_count())
+    assert n_feeds <= 4
+    # and the streamed footprint undercuts the device-resident one
+    assert str_bytes < dev_bytes
+    t_str.invalidate_stream()
+
+
+# -- feed packer: native vs numpy bitwise parity -----------------------------
+def _toy_store():
+    rng = np.random.RandomState(0)
+    C, n_max, F = 5, 12, 3
+    x = rng.randn(C, n_max, F).astype(np.float32)
+    y = rng.randint(0, 10, (C, n_max)).astype(np.int32)
+    # heterogeneous sizes incl. a short (padded, wrapping) client and
+    # an EMPTY one (the inert padding-client edge: row plans for
+    # size 0 degenerate to row 0)
+    sizes = np.asarray([12, 5, 1, 0, 7], np.int32)
+    return HostClientStore(ClientData(x=x, y=y, sizes=sizes))
+
+
+def _force_numpy_fallback(monkeypatch):
+    import fedtorch_tpu.native.host_pipeline as hp
+    monkeypatch.setattr(hp, "_lib", None)
+    monkeypatch.setattr(hp, "_lib_tried", True)
+
+
+@pytest.mark.parametrize("order", ["fwd", "rev"])
+def test_feed_packer_native_equals_numpy(monkeypatch, order):
+    """The packed feed must be bitwise-identical whether the native
+    ft_gather_rows or the numpy fallback gathers it — both client
+    orders, wrapped short clients, and the empty-client edge — so CI
+    on toolchain-less hosts still pins the streaming contract."""
+    from fedtorch_tpu.native import native_available
+    store = _toy_store()
+    idx = np.asarray([3, 1, 0, 2], np.int64)
+    if order == "rev":
+        idx = idx[::-1].copy()
+    rng = np.random.RandomState(1)
+    rows = rng.randint(0, store.n_max, (4, 7)).astype(np.int64)
+    rows[np.where(idx == 3)[0][0]] = 0  # empty client: plan is row 0
+
+    numpy_ref = RoundFeed(
+        idx=idx.astype(np.int32), sizes=store.sizes[idx],
+        x=store.x[idx[:, None], rows], y=store.y[idx[:, None], rows],
+        pre_x=store.x[idx[:, None], np.arange(2)[None, :]],
+        pre_y=store.y[idx[:, None], np.arange(2)[None, :]])
+
+    if native_available():
+        native_feed = store.pack(idx, rows, batch_size=2)
+        assert_trees_equal(tuple(native_feed), tuple(numpy_ref))
+    _force_numpy_fallback(monkeypatch)
+    fallback_feed = store.pack(idx, rows, batch_size=2)
+    assert_trees_equal(tuple(fallback_feed), tuple(numpy_ref))
+
+
+def test_feed_nbytes_counts_all_leaves():
+    store = _toy_store()
+    feed = store.pack(np.asarray([0, 1]), np.zeros((2, 4), np.int64), 2)
+    expected = sum(np.asarray(leaf).nbytes for leaf in feed)
+    assert feed_nbytes(feed) == expected
+
+
+def test_pre_rows_clamp_when_batch_exceeds_shard():
+    """batch_size > n_max: the hook batch must repeat the LAST row —
+    the device plane's jnp out-of-bounds gather clamps — instead of
+    walking the flat view into the next client's shard (or off the
+    end of the store for the last client)."""
+    import jax.numpy as jnp
+    store = _toy_store()  # n_max = 12
+    idx = np.asarray([1, 4])  # 4 is the LAST client: overflow would
+    #                           index past the end of the flat view
+    rows = np.zeros((2, 3), np.int64)
+    feed = store.pack(idx, rows, batch_size=15)
+    device_ref = np.asarray(
+        jnp.asarray(store.x)[idx[:, None], jnp.arange(15)[None, :]])
+    np.testing.assert_array_equal(feed.pre_x, device_ref)
+
+
+# -- supervisor interplay ----------------------------------------------------
+def test_supervisor_rollback_resyncs_stream(monkeypatch):
+    """A supervised unhealthy round rolls back AND reseeds — both
+    rewrite the (rng, round) pair the host producer replays from. The
+    rollback path must invalidate the stream so the retry re-syncs
+    instead of consuming stale feeds (which would raise a desync
+    error or silently feed wrong rows)."""
+    from fedtorch_tpu.robustness import RoundSupervisor
+    t = build("stream")
+    sup = RoundSupervisor(t, sleep_fn=lambda s: None)
+    fail_once = {"armed": True}
+    orig = RoundSupervisor._healthy
+
+    def flaky(self, health):
+        if fail_once["armed"]:
+            fail_once["armed"] = False
+            return False
+        return orig(self, health)
+
+    monkeypatch.setattr(RoundSupervisor, "_healthy", flaky)
+    server, clients = t.init_state(jax.random.key(0))
+    for _ in range(3):
+        server, clients, _ = sup.run_round(server, clients)
+    assert sup.stats.rollbacks == 1
+    assert sup.stats.rounds == 3
+    assert int(jax.device_get(server.round)) == 3
+    t.invalidate_stream()
+
+
+# -- gates / config / CLI ----------------------------------------------------
+def test_run_rounds_refused_on_stream_plane():
+    t = build("stream")
+    server, clients = t.init_state(jax.random.key(0))
+    with pytest.raises(RuntimeError, match="run_rounds"):
+        t.run_rounds(server, clients, 2)
+    t.invalidate_stream()
+
+
+def test_explicit_shard_gather_refused():
+    cfg = make_cfg("stream")
+    data = build_federated_data(cfg)
+    model = define_model(cfg, batch_size=cfg.data.batch_size)
+    with pytest.raises(ValueError, match="shard"):
+        FederatedTrainer(cfg, model, make_algorithm(cfg), data.train,
+                         gather_mode="shard")
+
+
+@pytest.mark.parametrize("algorithm,kw,match", [
+    ("qffl", {"qffl_q": 1.0}, "FULL local dataset"),
+    ("fedavg", {"drfa": True}, "participation"),
+])
+def test_unsupported_algorithms_raise(algorithm, kw, match):
+    cfg = make_cfg("stream", algorithm=algorithm, **kw)
+    data = build_federated_data(cfg)
+    model = define_model(cfg, batch_size=cfg.data.batch_size)
+    with pytest.raises(ValueError, match=match):
+        FederatedTrainer(cfg, model, make_algorithm(cfg), data.train)
+
+
+def test_personal_val_split_raises():
+    cfg = make_cfg("stream", algorithm="apfl")
+    data = build_federated_data(cfg)
+    model = define_model(cfg, batch_size=cfg.data.batch_size)
+    with pytest.raises(ValueError, match="validation"):
+        FederatedTrainer(cfg, model, make_algorithm(cfg), data.train,
+                         val_data=data.val)
+
+
+def test_config_rejects_unknown_plane():
+    with pytest.raises(ValueError, match="data_plane"):
+        ExperimentConfig(
+            data=DataConfig(data_plane="rows")).finalize()
+
+
+def test_cli_flag_maps():
+    from fedtorch_tpu.cli import args_to_config, build_parser
+    args = build_parser().parse_args(
+        ["--federated", "true", "-d", "synthetic",
+         "--data_plane", "stream"])
+    assert args_to_config(args).data.data_plane == "stream"
+    assert dataclasses.asdict(
+        args_to_config(build_parser().parse_args(
+            ["--federated", "true", "-d", "synthetic"]))
+    )["data"]["data_plane"] == "device"
