@@ -1,0 +1,18 @@
+"""Asynchronous buffered federation plane (FedBuff-style server).
+
+Selected via ``cfg.federated.sync_mode='async'`` / ``--sync_mode
+async``; ``sync`` (the default) is the round-synchronous engine,
+bitwise-identical to the pre-async build. See docs/robustness.md
+"Asynchronous federation" and docs/performance.md for the buffer
+semantics, staleness math, snapshot-ring memory cost, and when sync
+still wins.
+"""
+from fedtorch_tpu.async_plane.commit import (  # noqa: F401
+    ASYNC_ALGORITHMS, AsyncFederatedTrainer, CommitJobs,
+)
+from fedtorch_tpu.async_plane.scheduler import (  # noqa: F401
+    AsyncSchedule, HostCommitPlan, simulate_sync_round_times,
+)
+from fedtorch_tpu.async_plane.staleness import (  # noqa: F401
+    STALENESS_MODES, normalized_staleness_weights, staleness_weight,
+)

@@ -1,0 +1,411 @@
+"""The asynchronous buffered federation plane (FedBuff-style server).
+
+``cfg.federated.sync_mode='async'`` replaces the blocking round with a
+COMMIT loop (Nguyen et al., arXiv:2106.06639; FedScale's async mode,
+Lai et al. 2022): ``concurrency`` clients are always training, each
+against the server snapshot current at its dispatch; the server folds
+finished updates into a buffer of ``m = async_buffer_size`` and commits
+when it fills — so the commit clock follows the FASTEST m arrivals and
+a straggler delays only itself, not the round.
+
+Execution shape (everything trace-once and deterministic):
+
+* **Event schedule** (:mod:`.scheduler`): completion order is a pure
+  function of (seed, commit) — threefry-derived delays reusing the
+  chaos subsystem's straggler knobs. No update is materialized before
+  its commit; the jitted COMMIT PROGRAM computes all m buffered local
+  trainings at once, each against its own snapshot.
+* **Snapshot ring**: ``server.aux`` is wrapped as ``{'alg': <algorithm
+  aux>, 'ring': {'params', 'aux'}}`` — the last ``snapshot_ring``
+  committed (params, server-aux) versions as stacked [R] trees, indexed
+  in-program by each job's dispatch version. The wrap rides the
+  existing checkpoint path, which is what makes a preempted async run
+  resumable bitwise (tests/test_preemption.py).
+* **Staleness weighting** (:mod:`.staleness`): each update's
+  aggregation weight is damped by s(commit - version) and the composed
+  weights flow through the guard renormalization
+  (robustness/guards.py) — a rejected stale update hands back exactly
+  its damped weight.
+* **Commit program** = the sync engine's ``_round_core`` re-dispatched
+  through its commit seam (parallel/federated.py): per-job base
+  params/aux threaded through every local hook — SCAFFOLD's control
+  step ``g + c - c_i`` and its control update both read the STALE
+  server control the client actually trained against, which is the
+  stale-snapshot correction async SCAFFOLD needs — then guards,
+  renormalization, server step against the CURRENT params, and the
+  ring rotates.
+
+Algorithm gate: FedAvg/FedProx/FedAdam (server-side adaptivity) and
+SCAFFOLD are wired; families whose hooks read global round structure
+the buffer breaks (AFL/qFFL losses over the full cohort, DRFA's dual
+phase and lambda participation, the personalized families' val
+streams, qsparse's post-round tracking variate) raise a single
+ValueError at construction naming the gate — never deep in tracing.
+"""
+from __future__ import annotations
+
+import weakref
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedtorch_tpu.algorithms.base import FedAlgorithm
+from fedtorch_tpu.async_plane.scheduler import (
+    ASYNC_TRAIN_SALT, AsyncSchedule,
+)
+from fedtorch_tpu.async_plane.staleness import (
+    normalized_staleness_weights,
+)
+from fedtorch_tpu.config import ExperimentConfig
+from fedtorch_tpu.core.state import tree_broadcast_clients
+from fedtorch_tpu.data.batching import ClientData, round_row_plan
+from fedtorch_tpu.data.streaming import (
+    StreamFeedProducer, _cpu_device, _cpu_scope,
+)
+from fedtorch_tpu.models.common import ModelDef
+from fedtorch_tpu.parallel.federated import FederatedTrainer
+from fedtorch_tpu.parallel.mesh import replicate
+from fedtorch_tpu.robustness.chaos import draw_chaos_plan, no_chaos_plan
+from fedtorch_tpu.utils.tracing import instrument_trace
+
+ASYNC_ALGORITHMS = ("fedavg", "fedprox", "fedadam", "scaffold")
+
+
+class CommitJobs(NamedTuple):
+    """One commit's buffered updates as device inputs (all [m])."""
+    idx: jnp.ndarray        # int32 client ids (distinct)
+    version: jnp.ndarray    # int32 snapshot version each trained on
+    dispatch: jnp.ndarray   # int32 global dispatch counter (rng fold)
+    straggler: jnp.ndarray  # float32 {0,1} tail-delay dispatches
+
+
+def _gate(why: str) -> ValueError:
+    return ValueError(
+        f"sync_mode='async' is unsupported here: {why}; "
+        "use --sync_mode sync")
+
+
+class _AsyncRowPlan:
+    """Host replica of the commit program's row plan (the async twin of
+    ``data.streaming.RoundSchedule``): given the dispatch ids and
+    client ids of one commit, reproduces EXACTLY the per-job training
+    rngs (``fold_in(server.rng, ASYNC_TRAIN_SALT)`` then the dispatch
+    fold) and ``round_row_plan`` rows the device commit program derives
+    — threefry is backend-deterministic, so the CPU replay is
+    bit-exact."""
+
+    def __init__(self, key_data, key_impl, n_max: int, num_rows: int,
+                 sizes: np.ndarray):
+        self._cpu = _cpu_device()
+        sizes = np.asarray(sizes, np.int32)
+
+        def rows_fn(key, dispatch, idx):
+            rngs = jax.vmap(lambda d: jax.random.fold_in(
+                jax.random.fold_in(key, ASYNC_TRAIN_SALT), d))(dispatch)
+            on_sizes = jnp.take(jnp.asarray(sizes), idx)
+            return jax.vmap(lambda r, s: round_row_plan(
+                r, s, n_max, num_rows))(rngs, on_sizes)
+
+        with self._scope():
+            self._key = jax.random.wrap_key_data(
+                jnp.asarray(np.asarray(key_data)), impl=key_impl)
+            # the key input is reused by every commit's replay
+            # lint: disable=FTL004 — key reused every commit
+            self._jit = jax.jit(rows_fn)
+
+    def _scope(self):
+        return _cpu_scope(self._cpu)
+
+    def __call__(self, dispatch: np.ndarray, idx: np.ndarray):
+        with self._scope():
+            rows = self._jit(self._key,
+                             np.asarray(dispatch, np.int32),
+                             np.asarray(idx, np.int32))
+            return np.asarray(jax.device_get(rows))
+
+
+class AsyncFederatedTrainer(FederatedTrainer):
+    """Drop-in trainer for ``sync_mode='async'``: :meth:`run_round`
+    executes one COMMIT (``server.round`` counts commit versions, so
+    the CLI round loop, checkpointing, eval cadence, preemption drain
+    and the supervisor all work unchanged)."""
+
+    supports_async = True
+
+    def __init__(self, cfg: ExperimentConfig, model: ModelDef,
+                 algorithm: FedAlgorithm, data: ClientData,
+                 val_data=None, mesh=None, gather_mode: str = "auto"):
+        fed = cfg.federated
+        # -- the async gate matrix (tests/test_federated.py) ----------
+        alg_name = cfg.effective_algorithm
+        if alg_name not in ASYNC_ALGORITHMS:
+            raise _gate(
+                f"algorithm {alg_name!r} is not wired for stale-snapshot"
+                f" commits (supported: {', '.join(ASYNC_ALGORITHMS)}; "
+                "AFL/qFFL aggregate cohort-global losses, DRFA adds a "
+                "dual phase and lambda participation, the personalized "
+                "families need per-client val streams, and qsparse's "
+                "tracking variate assumes the round's payload sum)")
+        if val_data is not None or fed.personal:
+            raise _gate("per-client validation splits "
+                        "(cfg.federated.personal) are not buffered")
+        if cfg.mesh.client_fusion == "fused":
+            raise _gate("client_fusion='fused' packs clients into one "
+                        "grouped conv against ONE shared server "
+                        "snapshot; buffered commits train each client "
+                        "against its own version")
+        if gather_mode == "shard":
+            raise _gate("gather_mode='shard' moves whole client shards; "
+                        "the commit program packs each buffered job's "
+                        "rows (the 'batch' plan)")
+        k_online = max(int(fed.online_client_rate * data.num_clients), 1)
+        self.concurrency = fed.async_concurrency or k_online
+        self.buffer_size = fed.async_buffer_size or max(
+            1, self.concurrency // 2)
+        if self.buffer_size > self.concurrency:
+            raise _gate(
+                f"async_buffer_size ({self.buffer_size}) exceeds the "
+                f"in-flight concurrency ({self.concurrency}) — a commit "
+                "could never fill")
+        if data.num_clients < self.concurrency + self.buffer_size:
+            raise _gate(
+                f"num_clients ({data.num_clients}) must be >= "
+                f"concurrency + buffer ({self.concurrency} + "
+                f"{self.buffer_size}) so every arrival has a distinct "
+                "replacement to dispatch")
+        self.snapshot_ring = fed.snapshot_ring
+
+        super().__init__(cfg, model, algorithm, data, val_data=val_data,
+                         mesh=mesh, gather_mode=gather_mode)
+
+        # commits always consume packed rows (round_row_plan order)
+        self.gather_mode = "batch"
+        # async stragglers are arrival DELAYS (the scheduler), not step
+        # cuts — the freeze mask is epoch-sync-only here
+        self.mask_steps = self.epoch_sync
+
+        self._sched: Optional[AsyncSchedule] = None
+        self.commit_trace_name = \
+            f"federated.commit[{algorithm.name}]"
+        self._commit_jit = jax.jit(
+            instrument_trace(self.commit_trace_name,
+                             self._commit_device_fn),
+            donate_argnums=(0, 1)) \
+            if self.data_plane == "device" else None
+        self.commit_stream_trace_name = \
+            f"federated.commit_stream[{algorithm.name}]"
+        self._commit_stream_jit = jax.jit(
+            instrument_trace(self.commit_stream_trace_name,
+                             self._commit_stream_fn),
+            donate_argnums=(0, 1)) \
+            if self.data_plane == "stream" else None
+
+    # -- state -----------------------------------------------------------
+    def init_state(self, rng: jax.Array):
+        """Sync init, then wrap the server aux with the snapshot ring:
+        every slot starts as version 0 (the init params/aux), which is
+        exactly what the initial in-flight cohort trains against."""
+        server, clients = super().init_state(rng)
+        R = self.snapshot_ring
+        ring = {"params": tree_broadcast_clients(server.params, R),
+                "aux": tree_broadcast_clients(server.aux, R)}
+        server = server._replace(aux={"alg": server.aux, "ring": ring})
+        return replicate(server, self.mesh), clients
+
+    # -- the jitted commit program ---------------------------------------
+    def _commit_core(self, server, clients, jobs: CommitJobs, on_x, on_y,
+                     pre_x, pre_y, on_sizes, rngs, rng_round):
+        """Unwrap the ring, gather each job's snapshot, and re-dispatch
+        ``_round_core`` through its commit seam; then rotate the ring
+        with the new version."""
+        fed = self.cfg.federated
+        alg_aux = server.aux["alg"]
+        ring = server.aux["ring"]
+        inner = server._replace(aux=alg_aux)
+        R = self.snapshot_ring
+        slot = jobs.version % R
+        take = lambda t: jax.tree.map(
+            lambda x: jnp.take(x, slot, axis=0), t)
+        base_params, base_aux = take(ring["params"]), take(ring["aux"])
+        stale = (server.round - jobs.version).astype(jnp.float32)
+        weight_scale = normalized_staleness_weights(
+            stale, fed.staleness_weight, fed.staleness_exponent)
+
+        # chaos composes: crash/NaN faults draw their usual per-commit
+        # folds; the straggler BUDGET cut is neutralized (stragglers
+        # already arrived late — cutting their steps too would double-
+        # apply the fault)
+        m = jobs.idx.shape[0]
+        flt = self.fault
+        if self.chaos_on:
+            plan = draw_chaos_plan(
+                jax.random.fold_in(rng_round, flt.chaos_salt), m, flt
+            )._replace(budget_scale=jnp.ones((m,)))
+        else:
+            plan = no_chaos_plan(m)
+
+        # no buffered val plane (gated in __init__): same placeholders
+        # as the stream plane
+        on_vx, on_vy = on_x[:, :1], on_y[:, :1]
+        on_vsizes = jnp.ones_like(on_sizes)
+        new_inner, new_clients, metrics = self._round_core(
+            inner, clients, jobs.idx, on_x, on_y, on_vx, on_vy,
+            on_sizes, on_vsizes, pre_x, pre_y, rng_round, rngs,
+            batch_mode=True, val_batch_mode=False,
+            base_params=base_params, base_aux=base_aux,
+            weight_scale=weight_scale, plan=plan)
+
+        # rotate the ring: the new commit version overwrites the oldest
+        # retained slot (new_inner.round == server.round + 1)
+        new_slot = new_inner.round % R
+        new_ring = {
+            "params": jax.tree.map(
+                lambda r, p: r.at[new_slot].set(p),
+                ring["params"], new_inner.params),
+            "aux": jax.tree.map(
+                lambda r, a: r.at[new_slot].set(a),
+                ring["aux"], new_inner.aux),
+        }
+        new_server = new_inner._replace(
+            aux={"alg": new_inner.aux, "ring": new_ring})
+        metrics = metrics._replace(
+            straggler_clients=jnp.sum(jobs.straggler),
+            staleness_mean=jnp.mean(stale))
+        return new_server, new_clients, metrics
+
+    def _job_rngs(self, server, jobs: CommitJobs):
+        """Per-job training streams keyed by the GLOBAL dispatch
+        counter, not the commit index — two dispatches of one client
+        against different versions must not share a batch order."""
+        return jax.vmap(lambda d: jax.random.fold_in(
+            jax.random.fold_in(server.rng, ASYNC_TRAIN_SALT), d)
+        )(jobs.dispatch)
+
+    def _commit_device_fn(self, server, clients, jobs: CommitJobs,
+                          data: ClientData):
+        """Device data plane: gather each buffered job's rows in-program
+        (the same ``round_row_plan`` the host feed packer replays, so
+        the two async data planes are bitwise-identical)."""
+        K, B = self.local_steps, self.batch_size
+        rng_round = jax.random.fold_in(server.rng, server.round)
+        rngs = self._job_rngs(server, jobs)
+        idx = jobs.idx
+        on_sizes = jnp.take(data.sizes, idx)
+        rows = jax.vmap(lambda r, s: round_row_plan(
+            r, s, data.x.shape[1], K * B))(rngs, on_sizes)
+        on_x = data.x[idx[:, None], rows]
+        on_y = data.y[idx[:, None], rows]
+        pre_x = data.x[idx[:, None], jnp.arange(B)[None, :]]
+        pre_y = data.y[idx[:, None], jnp.arange(B)[None, :]]
+        return self._commit_core(server, clients, jobs, on_x, on_y,
+                                 pre_x, pre_y, on_sizes, rngs, rng_round)
+
+    def _commit_stream_fn(self, server, clients, jobs: CommitJobs,
+                          feed):
+        """Streaming data plane: the commit consumes a host-packed feed
+        built one COMMIT ahead by the producer (keyed by commit
+        version, not round index)."""
+        rng_round = jax.random.fold_in(server.rng, server.round)
+        rngs = self._job_rngs(server, jobs)
+        return self._commit_core(server, clients, jobs, feed.x, feed.y,
+                                 feed.pre_x, feed.pre_y, feed.sizes,
+                                 rngs, rng_round)
+
+    # -- host-side commit loop -------------------------------------------
+    def _schedule_args(self) -> dict:
+        flt = self.fault
+        return dict(
+            num_clients=self.num_clients, concurrency=self.concurrency,
+            buffer_size=self.buffer_size, ring_size=self.snapshot_ring,
+            straggler_rate=flt.straggler_rate,
+            straggler_step_frac=flt.straggler_step_frac)
+
+    def _server_key_state(self, server):
+        """One batched fetch of (raw key data, commit) — paid only at
+        (re)start, exactly like the sync stream plane's resync."""
+        key_data, round0 = jax.device_get(
+            (jax.random.key_data(server.rng), server.round))
+        return key_data, jax.random.key_impl(server.rng), int(round0)
+
+    def _ensure_schedule(self, server) -> None:
+        if self._sched is not None:
+            return
+        key_data, key_impl, commit0 = self._server_key_state(server)
+        self._sched = AsyncSchedule(key_data, key_impl,
+                                    start_commit=commit0,
+                                    **self._schedule_args())
+
+    def _ensure_async_stream(self, server) -> None:
+        if self._stream is not None:
+            return
+        key_data, key_impl, commit0 = self._server_key_state(server)
+        sched = AsyncSchedule(key_data, key_impl, start_commit=commit0,
+                              **self._schedule_args())
+        # visible to schedule_stats / commit_times consumers on this
+        # plane too (scripts/async_bench.py reads both); the producer
+        # thread owns the simulation, so counters may run up to the
+        # prefetch depth AHEAD of the last consumed commit
+        self._sched = sched
+        rows_fn = _AsyncRowPlan(
+            key_data, key_impl, self.host_store.n_max,
+            self.local_steps * self.batch_size, self.host_store.sizes)
+
+        def plan_fn(step: int):
+            plan = sched.next_commit()
+            rows = rows_fn(plan.dispatch, plan.idx)
+            jobs = CommitJobs(idx=plan.idx, version=plan.version,
+                              dispatch=plan.dispatch,
+                              straggler=plan.straggler)
+            return plan.commit, plan.idx, rows, jobs
+
+        # plan_fn must not close over self (producer-thread leak guard,
+        # see FederatedTrainer._next_stream_feed)
+        mesh = self.mesh
+        self._stream = StreamFeedProducer(
+            self.host_store, batch_size=self.batch_size,
+            start_round=commit0, plan_fn=plan_fn,
+            place_fn=lambda t: replicate(t, mesh))
+        self._stream_finalizer = weakref.finalize(
+            self, StreamFeedProducer.close, self._stream)
+
+    def run_round(self, server, clients):
+        """One COMMIT: pop the scheduler's next m arrivals, run the
+        commit program. Sequential-consumption contract and
+        :meth:`invalidate_stream` resync semantics are the stream
+        plane's (the scheduler replays from the live device state on
+        (re)start, so supervisor rollback/reseed, checkpoint resume and
+        the CLI drain all work unchanged)."""
+        if self.data_plane == "stream":
+            self._ensure_async_stream(server)
+            feed, jobs = self._stream.next_feed()
+            return self._commit_stream_jit(server, clients, jobs, feed)
+        self._ensure_schedule(server)
+        plan = self._sched.next_commit()
+        jobs = CommitJobs(idx=plan.idx, version=plan.version,
+                          dispatch=plan.dispatch,
+                          straggler=plan.straggler)
+        return self._commit_jit(server, clients, jobs, self.data)
+
+    def run_rounds(self, server, clients, num_rounds: int):
+        raise ValueError(
+            "run_rounds is unsupported on the async commit plane: it "
+            "scans ONE traced round program over device-resident data, "
+            "but async commits are host-scheduled events (each commit's "
+            "jobs come from the event scheduler) — call run_round once "
+            "per commit (docs/robustness.md 'Asynchronous federation')")
+
+    def invalidate_stream(self) -> None:
+        """Also drop the event scheduler: any rewrite of host-visible
+        training state (supervisor rollback/reseed, resume, drain)
+        desyncs the replay; the next commit re-syncs from the live
+        (rng, round) device state."""
+        super().invalidate_stream()
+        self._sched = None
+
+    @property
+    def schedule_stats(self):
+        """Scheduler counters (dispatches/stragglers/ring clamps) —
+        None before the first commit."""
+        return self._sched.stats if self._sched is not None else None

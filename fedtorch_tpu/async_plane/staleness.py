@@ -1,0 +1,50 @@
+"""Staleness-weighted aggregation for the async commit plane.
+
+A buffered update that trained against a snapshot ``tau`` commits old
+carries less information about the CURRENT server model than a fresh
+one; FedBuff (Nguyen et al., arXiv:2106.06639 §4) damps it with a
+staleness weight ``s(tau)`` before averaging. Three standard shapes:
+
+* ``poly`` — ``(1 + tau)^-a`` (the FedBuff polynomial default, a=0.5);
+* ``inv``  — ``1 / (1 + tau)`` (harmonic; ``poly`` with a=1);
+* ``const``— 1 (no damping; async ordering effects only).
+
+Every shape satisfies ``s(0) == 1`` — a zero-staleness update is never
+damped.
+
+:func:`normalized_staleness_weights` rescales a commit's weights to
+MEAN 1, so the composed aggregation weight (algorithm base weight x
+staleness, ``parallel/federated.py:_round_core``) sums to the same
+total as the sync round's — the server step keeps its sync magnitude,
+and an all-fresh commit (every tau = 0) reproduces the sync weighting
+exactly. Composition with the update guards is by construction: the
+composed weights feed ``guards.renormalize_accepted``, so a REJECTED
+stale update hands back exactly the damped weight it would have
+contributed (tested in tests/test_async_plane.py).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+STALENESS_MODES = ("const", "poly", "inv")
+
+
+def staleness_weight(tau, mode: str, exponent: float = 0.5):
+    """Raw ``s(tau)`` over a [k] staleness vector (commits, >= 0)."""
+    tau = jnp.asarray(tau, jnp.float32)
+    if mode == "const":
+        return jnp.ones_like(tau)
+    if mode == "poly":
+        return (1.0 + tau) ** (-exponent)
+    if mode == "inv":
+        return 1.0 / (1.0 + tau)
+    raise ValueError(
+        f"unknown staleness_weight mode {mode!r}; expected one of "
+        f"{STALENESS_MODES}")
+
+
+def normalized_staleness_weights(tau, mode: str, exponent: float = 0.5):
+    """``s(tau)`` normalized to mean 1 over the commit buffer — the
+    multiplier the engine composes into the aggregation weights."""
+    s = staleness_weight(tau, mode, exponent)
+    return s * (s.shape[0] / jnp.sum(s))

@@ -72,6 +72,34 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--quantized_bits", type=int, default=8)
     p.add_argument("--compressed", type=str2bool, default=False)
     p.add_argument("--compressed_ratio", type=float, default=1.0)
+    p.add_argument("--sync_mode", default="sync",
+                   choices=("sync", "async"),
+                   help="server execution plane: 'sync' (default) "
+                        "blocks each round on all k online clients; "
+                        "'async' is the FedBuff-style buffered server "
+                        "— clients train on possibly-stale snapshots, "
+                        "the server commits every --async_buffer_size "
+                        "staleness-weighted arrivals, and num_comms "
+                        "counts COMMITS (docs/robustness.md "
+                        "'Asynchronous federation')")
+    p.add_argument("--async_buffer_size", type=int, default=0,
+                   help="updates buffered per async commit (FedBuff's "
+                        "m); 0 = auto: max(1, k_online // 2)")
+    p.add_argument("--async_concurrency", type=int, default=0,
+                   help="concurrently-training clients in async mode "
+                        "(FedBuff's M_c); 0 = auto: k_online")
+    p.add_argument("--staleness_weight", default="poly",
+                   choices=("const", "poly", "inv"),
+                   help="async staleness damping s(tau) for an update "
+                        "tau commits stale: poly=(1+tau)^-exponent "
+                        "(FedBuff default), inv=1/(1+tau), const=1; "
+                        "normalized to mean 1 per commit")
+    p.add_argument("--staleness_exponent", type=float, default=0.5,
+                   help="exponent of the 'poly' staleness weight")
+    p.add_argument("--snapshot_ring", type=int, default=8,
+                   help="async snapshot ring depth: past commit "
+                        "versions kept resident for in-flight clients "
+                        "(memory: ring x (params + server aux))")
     p.add_argument("--federated_drfa", type=str2bool, default=False)
     p.add_argument("--drfa_gamma", type=float, default=0.1)
     p.add_argument("--perfedavg_beta", type=float, default=0.001)
@@ -315,6 +343,12 @@ def args_to_config(args) -> ExperimentConfig:
             online_client_rate=args.online_client_rate,
             sync_type=args.federated_sync_type,
             num_epochs_per_comm=args.num_epochs_per_comm,
+            sync_mode=args.sync_mode,
+            async_buffer_size=args.async_buffer_size,
+            async_concurrency=args.async_concurrency,
+            staleness_weight=args.staleness_weight,
+            staleness_exponent=args.staleness_exponent,
+            snapshot_ring=args.snapshot_ring,
             algorithm=args.federated_type, personal=args.fed_personal,
             personal_alpha=args.fed_personal_alpha,
             adaptive_alpha=args.fed_adaptive_alpha,
@@ -496,8 +530,18 @@ def run_experiment(cfg: ExperimentConfig,
         return {"test_top1": float(res.top1), "rounds": len(history)}
 
     algorithm = make_algorithm(cfg)
-    trainer = FederatedTrainer(cfg, model, algorithm, fed_data.train,
-                               val_data=fed_data.val)
+    if cfg.federated.sync_mode == "async":
+        # the async commit plane (docs/robustness.md "Asynchronous
+        # federation"): run_round executes one COMMIT and server.round
+        # counts commit versions, so the loop below — checkpointing,
+        # eval cadence, preemption drain, supervisor — runs unchanged
+        from fedtorch_tpu.async_plane import AsyncFederatedTrainer
+        trainer = AsyncFederatedTrainer(cfg, model, algorithm,
+                                        fed_data.train,
+                                        val_data=fed_data.val)
+    else:
+        trainer = FederatedTrainer(cfg, model, algorithm, fed_data.train,
+                                   val_data=fed_data.val)
     server, clients = trainer.init_state(rng)
     server, clients, best_prec1, resumed = maybe_resume(
         cfg.checkpoint.resume, server, clients, cfg,

@@ -99,6 +99,36 @@ class FederatedConfig:
     sync_type: str = "epoch"  # 'epoch' | 'local_step'
     num_epochs_per_comm: int = 1
     algorithm: str = "fedavg"  # --federated_type
+    # Server execution plane (docs/robustness.md "Asynchronous
+    # federation"): 'sync' (default, the reference-faithful seed
+    # behavior) blocks each round on all k online clients; 'async' is
+    # the FedBuff-style buffered server (arXiv:2106.06639) — clients
+    # train against a possibly-stale snapshot from a commit-versioned
+    # ring, the server folds arrivals into a buffer of
+    # ``async_buffer_size`` staleness-weighted updates and commits
+    # through the guard/renormalization path when it fills. In async
+    # mode ``num_comms`` counts COMMITS and ``fault.straggler_rate``
+    # draws arrival DELAYS (long-tail wall-clock), not step cuts.
+    sync_mode: str = "sync"  # 'sync' | 'async'
+    # updates buffered per commit (FedBuff's m). 0 = auto:
+    # max(1, k_online // 2) — commits gate on the fastest half of the
+    # in-flight cohort, never on the slowest client.
+    async_buffer_size: int = 0
+    # concurrently-training clients (FedBuff's M_c). 0 = auto: k_online
+    # (the sync round's compute budget).
+    async_concurrency: int = 0
+    # staleness weight s(tau) applied to a buffered update that trained
+    # against a snapshot tau commits old: 'poly' = (1+tau)^-exponent
+    # (the FedBuff default), 'inv' = 1/(1+tau), 'const' = 1. Weights
+    # are normalized to mean 1 per commit, so tau=0 reproduces the sync
+    # aggregation weighting exactly (async_plane/staleness.py).
+    staleness_weight: str = "poly"
+    staleness_exponent: float = 0.5
+    # server snapshot ring depth: how many past commit versions stay
+    # resident for in-flight clients (memory cost: ring x (params +
+    # server aux)). Updates older than the ring are clamped to the
+    # oldest retained snapshot (counted in the scheduler stats).
+    snapshot_ring: int = 8
     # Personalization.
     personal: bool = False          # --fed_personal
     personal_alpha: float = 0.5     # APFL mixing alpha
@@ -475,6 +505,32 @@ class ExperimentConfig:
             raise ValueError(
                 f"data.data_plane must be 'device' or 'stream', got "
                 f"{data.data_plane!r}")
+        if fed.sync_mode not in ("sync", "async"):
+            raise ValueError(
+                f"federated.sync_mode must be 'sync' or 'async', got "
+                f"{fed.sync_mode!r}")
+        if fed.sync_mode == "async":
+            if not fed.federated:
+                raise ValueError(
+                    "sync_mode='async' is a federated-server execution "
+                    "plane; it requires federated=True")
+            if fed.staleness_weight not in ("const", "poly", "inv"):
+                raise ValueError(
+                    "federated.staleness_weight must be 'const', 'poly' "
+                    f"or 'inv', got {fed.staleness_weight!r}")
+            if fed.staleness_exponent <= 0.0:
+                raise ValueError(
+                    "federated.staleness_exponent must be > 0, got "
+                    f"{fed.staleness_exponent}")
+            if fed.async_buffer_size < 0 or fed.async_concurrency < 0:
+                raise ValueError(
+                    "federated.async_buffer_size/async_concurrency must "
+                    "be >= 0 (0 = auto)")
+            if fed.snapshot_ring < 2:
+                raise ValueError(
+                    "federated.snapshot_ring must be >= 2 (the ring "
+                    "holds at least the current and previous commit), "
+                    f"got {fed.snapshot_ring}")
         if fed.algorithm not in FEDERATED_ALGORITHMS:
             raise ValueError(f"Unknown federated algorithm {fed.algorithm!r}; "
                              f"expected one of {FEDERATED_ALGORITHMS}")

@@ -50,8 +50,12 @@ class RoundMetrics(NamedTuple):
     comm_bytes: jnp.ndarray   # scalar — payload volume this round
     dropped_clients: jnp.ndarray = 0.0    # scalar — chaos crashes
     straggler_clients: jnp.ndarray = 0.0  # scalar — step-budget cuts
+    # (async plane: delayed dispatches folded into this commit)
     rejected_updates: jnp.ndarray = 0.0   # scalar — guard rejections
     clipped_updates: jnp.ndarray = 0.0    # scalar — guard norm clips
+    # async commit plane only: mean commit-version staleness of the
+    # buffered updates this commit consumed (0 on the sync planes)
+    staleness_mean: jnp.ndarray = 0.0     # scalar
 
 
 def tree_where(pred, on_true, on_false):

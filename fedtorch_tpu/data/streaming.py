@@ -141,6 +141,16 @@ def _cpu_device():
         return None
 
 
+def _cpu_scope(device):
+    """Dispatch scope pinning a host replay's jitted draws to the CPU
+    backend (identity scope when the platform is unavailable —
+    single-backend builds). One definition for every host-replay twin:
+    :class:`RoundSchedule` here and the async plane's scheduler / row
+    plan (``async_plane/scheduler.py``, ``async_plane/commit.py``)."""
+    return jax.default_device(device) if device is not None \
+        else contextlib.nullcontext()
+
+
 class RoundSchedule:
     """Host replica of the round program's index schedule.
 
@@ -181,8 +191,7 @@ class RoundSchedule:
             self._jit = jax.jit(sched)
 
     def _scope(self):
-        return jax.default_device(self._cpu) if self._cpu is not None \
-            else contextlib.nullcontext()
+        return _cpu_scope(self._cpu)
 
     def __call__(self, round_idx: int):
         """``(idx, rows)`` as numpy — the one blocking fetch of the
@@ -201,39 +210,59 @@ class StreamFeedProducer:
     computes. ``place_fn`` is the trainer's sharding-aware placement
     (replicated over the mesh; multihost-safe via ``mesh._put``).
 
+    The producer is keyed by an abstract monotone STEP LABEL, not a
+    round index per se: the default plan replays the synchronous round
+    schedule (:class:`RoundSchedule`, label = round index), while the
+    async commit plane passes ``plan_fn`` and the label is the COMMIT
+    VERSION (its deterministic event scheduler decides which clients'
+    rows each commit consumes — async_plane/commit.py). ``plan_fn(step)
+    -> (label, idx, rows, extras)``; a non-None ``extras`` pytree is
+    placed on device alongside the feed and handed back with it.
+
     Feeds are strictly sequential from ``start_round``; a consumer that
-    observes a round mismatch (host state rewritten out from under the
+    observes a label mismatch (host state rewritten out from under the
     producer — supervisor rollback, resume) must discard the producer
     (``FederatedTrainer.invalidate_stream``) rather than reorder."""
 
-    def __init__(self, store: HostClientStore, *, key_data, key_impl,
-                 start_round: int, num_clients: int, k_online: int,
-                 local_steps: int, batch_size: int,
+    def __init__(self, store: HostClientStore, *, batch_size: int,
+                 start_round: int, key_data=None, key_impl=None,
+                 num_clients: Optional[int] = None,
+                 k_online: Optional[int] = None,
+                 local_steps: Optional[int] = None,
                  place_fn: Optional[Callable] = None, depth: int = 2,
-                 timeout_s: float = 120.0):
+                 timeout_s: float = 120.0,
+                 plan_fn: Optional[Callable] = None):
         self.store = store
         self.start_round = int(start_round)
         self.batch_size = batch_size
-        self.feed_rows = local_steps * batch_size
         self._place = place_fn if place_fn is not None else jax.device_put
         self._timeout_s = timeout_s
-        self._schedule = RoundSchedule(
-            key_data, key_impl, num_clients, k_online,
-            self.feed_rows, store.n_max, store.sizes)
+        self._plan_fn = plan_fn
+        if plan_fn is None:
+            self.feed_rows = local_steps * batch_size
+            self._schedule = RoundSchedule(
+                key_data, key_impl, num_clients, k_online,
+                self.feed_rows, store.n_max, store.sizes)
+        else:
+            self._schedule = None
         self._expected = self.start_round
         self.rounds_produced = 0
         self._prefetcher = HostPrefetcher(self._produce, depth=depth,
                                           name="stream-feed-producer")
 
     def _produce(self, step: int):
-        round_idx = self.start_round + step
-        idx, rows = self._schedule(round_idx)
+        if self._plan_fn is not None:
+            label, idx, rows, extras = self._plan_fn(step)
+        else:
+            label = self.start_round + step
+            idx, rows = self._schedule(label)
+            extras = None
         feed = self.store.pack(idx, rows, self.batch_size)
         # device_put dispatches the H2D copy and returns immediately —
         # the transfer rides behind the in-flight round's compute
-        placed = self._place(feed)
+        placed = self._place(feed if extras is None else (feed, extras))
         self.rounds_produced += 1
-        return round_idx, placed
+        return label, placed
 
     def next_feed(self) -> RoundFeed:
         round_idx, feed = self._prefetcher.next(timeout=self._timeout_s)

@@ -41,10 +41,26 @@ from jax.experimental.pallas import tpu as pltpu
 # (8, 128) or equal to the array dims (jax/_src/pallas/mosaic/
 # lowering.py:_check_block_mappings — validated against the real
 # lowering in round 5: a [1, block_q] lse block is REJECTED on-chip
-# even though the interpreter accepts it). Per-q-row statistics
-# therefore carry a broadcast 128-lane trailing dim, the same layout
-# production TPU flash kernels use; lane 0 is the value.
+# even though the interpreter accepts it). Per-q-row statistics in
+# VMEM SCRATCH therefore carry a broadcast 128-lane trailing dim, the
+# same layout production TPU flash kernels use; lane 0 is the value.
 _LANES = 128
+
+# jax renamed TPUCompilerParams -> CompilerParams; accept either so the
+# kernel (and its interpret-mode tests) run across the jaxlib span the
+# relay and the CI container actually ship
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams")
+# The lse HBM OUTPUT does not need the full broadcast: a [BH, T, 8]
+# array with a (1, block_q, 8) block also satisfies the rule (last
+# block dim EQUALS the array dim; block_q is a divisor block, >= 16 or
+# == T, so the sublane constraint holds) and Mosaic accepts the
+# lowering (pinned by the AOT-lowering tests in
+# tests/test_flash_attention.py). At 8 lanes the lse write is T*8*4
+# bytes per head — 16x less HBM traffic than the 128-lane broadcast
+# the advisor flagged (ADVICE r5: at D=64/bf16 the broadcast lse write
+# was ~4x the size of the o output itself).
+_LSE_LANES = 8
 
 # dispatch policy ('auto' backend selection) lives in the pallas-free
 # ops/attention_dispatch.py so the dense path never imports this
@@ -52,6 +68,15 @@ _LANES = 128
 from fedtorch_tpu.ops.attention_dispatch import (  # noqa: E402,F401
     FLASH_MIN_SEQ_LEN, resolve_attention,
 )
+
+
+def _kernel_finite(x):
+    """``jnp.isfinite`` spelled as a comparison: NaN and +/-inf both
+    compare False under ``abs(x) < inf``. The ``is_finite`` HLO has no
+    Pallas TPU lowering on the older jaxlibs this repo still runs
+    (the AOT-lowering tests pin this), and the comparison form lowers
+    everywhere with identical semantics."""
+    return jnp.abs(x) < jnp.inf
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
@@ -90,10 +115,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
         m = m_scr[:]                                     # [blk_q, 128]
         m_blk = jnp.max(s, axis=-1, keepdims=True)       # [blk_q, 1]
         m_new = jnp.maximum(m, m_blk)                    # [blk_q, 128]
-        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        m_safe = jnp.where(_kernel_finite(m_new), m_new, 0.0)
         p = jnp.exp(s - m_safe[:, :1])
-        p = jnp.where(jnp.isfinite(s), p, 0.0)           # [blk_q, blk_k]
-        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+        p = jnp.where(_kernel_finite(s), p, 0.0)           # [blk_q, blk_k]
+        corr = jnp.where(_kernel_finite(m), jnp.exp(m - m_safe), 0.0)
         m_scr[:] = m_new
         l_scr[:] = l_scr[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
         acc_scr[:] = acc_scr[:] * corr[:, :1] + jax.lax.dot_general(
@@ -111,8 +136,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
     def _():
         l_safe = jnp.maximum(l_scr[:], 1e-30)
         o_ref[0] = (acc_scr[:] / l_safe[:, :1]).astype(o_ref.dtype)
-        m_fin = jnp.where(jnp.isfinite(m_scr[:]), m_scr[:], 0.0)
-        lse_ref[0] = m_fin + jnp.log(l_safe)             # [blk_q, 128]
+        m_fin = jnp.where(_kernel_finite(m_scr[:]), m_scr[:], 0.0)
+        # scratch stays 128-lane; only the first _LSE_LANES lanes hit
+        # HBM (every lane equal — lane 0 is the value)
+        lse_ref[0] = (m_fin + jnp.log(l_safe))[:, :lse_ref.shape[-1]]
 
 
 def _fwd_pallas(q3, k3, v3, scale: float, causal: bool, block_q: int,
@@ -127,7 +154,9 @@ def _fwd_pallas(q3, k3, v3, scale: float, causal: bool, block_q: int,
     # finding: the CPU path never hit this because off-TPU flash falls
     # back to the XLA oracle, so the real kernel inside shard_map was
     # first exercised on the chip).
-    vmas = [getattr(jax.typeof(t), "vma", None) for t in (q3, k3, v3)]
+    _typeof = getattr(jax, "typeof", None)
+    vmas = [getattr(_typeof(t), "vma", None) if _typeof is not None
+            else None for t in (q3, k3, v3)]
     # lint: disable=FTL005 — vma presence is static sharding metadata
     if any(v is not None for v in vmas):
         # pass vma even when EMPTY: inside shard_map with replicated
@@ -150,19 +179,21 @@ def _fwd_pallas(q3, k3, v3, scale: float, causal: bool, block_q: int,
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0),
+            pl.BlockSpec((1, block_q, _LSE_LANES),
+                         lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, T, D), q3.dtype, **vkw),
-            jax.ShapeDtypeStruct((BH, T, _LANES), jnp.float32, **vkw),
+            jax.ShapeDtypeStruct((BH, T, _LSE_LANES), jnp.float32,
+                                 **vkw),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),  # running max
             pltpu.VMEM((block_q, _LANES), jnp.float32),  # running sum
             pltpu.VMEM((block_q, D), jnp.float32),       # accumulator
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q3, k3, v3)
@@ -331,12 +362,25 @@ def _divisor_block(T: int, block: int) -> int:
 
 
 def _default_blocks(T: int):
-    """Data-driven default block shape (FLASH_BLOCK_SWEEP.json, v5e,
-    fetch-synced timer): 128x128 loses 1.36-2.45x to the per-T winner;
-    (256, 512) wins at T<=2048 and (512, 512) at T>=4096 (1.48x vs
-    dense forward at T=8192). Both fit VMEM comfortably (<=1 MB score
-    tile; _MAX_BLOCK_ELEMS)."""
-    return (256, 512) if T <= 2048 else (512, 512)
+    """Data-driven default block shape, settled per ADVICE r5 + ROADMAP
+    item 3:
+
+    * T >= 4096 — (512, 512): well supported by the forward sweep
+      (FLASH_BLOCK_SWEEP.json, v5e, fetch-synced: 1.48x vs dense
+      forward at T=8192).
+    * T <= 2048 — (128, 128), the previously-validated shape. The
+      (256, 512) pick came from a SINGLE forward-only sweep point at
+      T=2048 (1.08x — inside the documented +/-30% relay noise), and
+      the re-run TRAINING A/B at those defaults regressed to 0.68x at
+      T=2048 vs 1.04x at the original 128x128 (FLASH_TRAIN.json). Per
+      the repo's measured-not-predicted rule the training measurement
+      wins; more fetch-synced sub-2048 samples can revisit this.
+
+    Both fit VMEM comfortably (<=1 MB score tile; _MAX_BLOCK_ELEMS).
+    Note 'auto' attention dispatch routes T < 4096 to dense anyway
+    (ops/attention_dispatch.py), so the sub-2048 default only governs
+    explicit ``attention='flash'`` requests."""
+    return (128, 128) if T <= 2048 else (512, 512)
 
 
 def _prep(q, k, v, scale, block_q, block_k, force):

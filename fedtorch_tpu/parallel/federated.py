@@ -83,7 +83,9 @@ from fedtorch_tpu.parallel.mesh import (
 from fedtorch_tpu.robustness.chaos import (
     draw_chaos_plan, no_chaos_plan, poison_tree,
 )
-from fedtorch_tpu.robustness.guards import screen_payloads
+from fedtorch_tpu.robustness.guards import (
+    renormalize_accepted, screen_payloads,
+)
 from fedtorch_tpu.utils.tracing import instrument_trace
 
 
@@ -106,10 +108,23 @@ class FederatedTrainer:
     create_components, gen_aux_models — nodes/nodes.py:43-112) happen in
     :meth:`init_state`; the round loop lives in :meth:`round_fn`."""
 
+    # the async commit plane (fedtorch_tpu.async_plane) subclasses this
+    # trainer and flips the flag; constructing the BASE trainer with an
+    # async config would silently run round-synchronous semantics, so
+    # it refuses instead (docs/robustness.md "Asynchronous federation")
+    supports_async = False
+
     def __init__(self, cfg: ExperimentConfig, model: ModelDef,
                  algorithm: FedAlgorithm, data: ClientData,
                  val_data: Optional[ClientData] = None, mesh=None,
                  gather_mode: str = "auto"):
+        if cfg.federated.sync_mode == "async" and not self.supports_async:
+            raise ValueError(
+                "sync_mode='async' is unsupported here: the base "
+                "FederatedTrainer is round-synchronous — build the "
+                "trainer through the CLI or "
+                "fedtorch_tpu.async_plane.AsyncFederatedTrainer; "
+                "use --sync_mode sync for this class")
         self.cfg = cfg
         self.model = model
         self.algorithm = algorithm
@@ -387,7 +402,8 @@ class FederatedTrainer:
     def _round_core(self, server: ServerState, clients: ClientState,
                     idx, on_x, on_y, on_vx, on_vy, on_sizes, on_vsizes,
                     pre_x, pre_y, rng_round, rngs, *, batch_mode: bool,
-                    val_batch_mode: bool, data=None):
+                    val_batch_mode: bool, data=None, base_params=None,
+                    base_aux=None, weight_scale=None, plan=None):
         """The round program proper, data-plane agnostic: everything
         after the online rows exist — local loops, chaos/guards,
         aggregation, server step, state scatter, metrics. ``on_x`` is
@@ -395,21 +411,42 @@ class FederatedTrainer:
         client shards [k, n_max, ...]. ``data`` (the full store) is
         only threaded for ``post_round_global`` (DRFA's dual phase) —
         the streaming plane, which gates such algorithms out, passes
-        None."""
+        None.
+
+        COMMIT-DISPATCH SEAM (async_plane/commit.py; a down payment on
+        the ROADMAP-4 round-program compiler): the keyword overrides
+        let a caller re-dispatch this same core as an asynchronous
+        buffered COMMIT instead of a synchronous round —
+        ``base_params``/``base_aux`` thread a PER-CLIENT [k] server
+        snapshot (params + server aux) through every local-loop hook
+        (each buffered client trained against a possibly-stale commit
+        version), ``weight_scale`` composes staleness weights into the
+        aggregation weights before the guard renormalization, and
+        ``plan`` substitutes a caller-built chaos plan (async stragglers
+        are arrival DELAYS, not step cuts). All four default to None,
+        which traces exactly the synchronous program."""
         cfg, model, alg = self.cfg, self.model, self.algorithm
         K, B, C = self.local_steps, self.batch_size, self.num_clients
+        # the online axis length: k_online for the sync planes, the
+        # commit buffer size m for the async plane
+        k = idx.shape[0]
         num_online_eff = num_online_effective(idx)
         weights = alg.client_weights(server.aux, idx, num_online_eff,
                                      on_sizes)
+        if weight_scale is not None:
+            # staleness weighting (async_plane/staleness.py): composed
+            # INTO the aggregation weights, so the guard renormalization
+            # below redistributes exactly the composed weight
+            weights = weights * weight_scale
 
         # deterministic chaos schedule for this round (crash/straggler/
         # poison masks over the online clients) — its own fold of the
         # round key, so fault-free streams are untouched
         flt = self.fault
-        plan = draw_chaos_plan(
-            jax.random.fold_in(rng_round, flt.chaos_salt),
-            self.k_online, flt) if self.chaos_on \
-            else no_chaos_plan(self.k_online)
+        if plan is None:
+            plan = draw_chaos_plan(
+                jax.random.fold_in(rng_round, flt.chaos_salt),
+                k, flt) if self.chaos_on else no_chaos_plan(k)
 
         # gather online-client state (the per-round new_group)
         take = lambda t: jax.tree.map(lambda x: jnp.take(x, idx, axis=0), t)
@@ -428,12 +465,15 @@ class FederatedTrainer:
         on_clients = on_clients._replace(aux=on_aux0)
 
         def client_round(cstate: ClientState, x, y, vx, vy, size, vsize,
-                         weight, rng_c, bscale):
+                         weight, rng_c, bscale, base_p, base_a):
             # batch mode: x/y are the round's pre-selected rows [K*B, ...]
             # shard mode: x/y are whole shards [n_max, ...], rows picked
-            # per step (nothing larger than the shard is materialized)
+            # per step (nothing larger than the shard is materialized).
+            # base_p/base_a are THIS client's server snapshot — the live
+            # server state on the sync planes (vmap in_axes=None), its
+            # dispatch-time commit version on the async plane
             nb = jnp.ceil(size / B)  # batches per local epoch
-            server_params = server.params
+            server_params = base_p
             carry0 = model.init_carry(B)
 
             full_loss = None
@@ -520,7 +560,7 @@ class FederatedTrainer:
                 n_params, n_opt, n_aux, n_rnn, loss, acc = alg.local_step(
                     params=params, opt=opt, client_aux=aux,
                     rnn_carry=rnn_carry, server_params=server_params,
-                    server_aux=server.aux, bx=bx, by=by, bval_x=bval_x,
+                    server_aux=base_a, bx=bx, by=by, bval_x=bval_x,
                     bval_y=bval_y, lr=lr, rng=drop_rng, step_idx=k,
                     local_index=li, step_budget=step_budget)
                 if self.mask_steps:
@@ -543,7 +583,7 @@ class FederatedTrainer:
             lr_end = lr_at(self.schedule, epoch)
             payload, aux = alg.client_payload(
                 delta=delta, client_aux=aux, params=params,
-                server_params=server_params, server_aux=server.aux,
+                server_params=server_params, server_aux=base_a,
                 lr=lr_end, local_steps=step_budget, weight=weight,
                 full_loss=full_loss)
             new_state = ClientState(params=params, opt=opt, aux=aux,
@@ -557,16 +597,28 @@ class FederatedTrainer:
         if self.client_fusion == "fused":
             # same per-client math, one grouped conv per layer — the
             # fusion gate guarantees the features the fused step does
-            # not thread (val batches, full loss, rnn carry) are off
+            # not thread (val batches, full loss, rnn carry) are off;
+            # the async plane forces 'vmap', so per-client bases never
+            # reach this branch
             payloads, deltas, new_on_clients, (losses, accs) = \
                 self._fused_client_round(server, on_clients, on_x, on_y,
                                          on_sizes, weights, rngs,
                                          plan.budget_scale, batch_mode)
         else:
+            # the per-client server snapshot: stacked [k] trees on the
+            # async commit plane, the live server state broadcast
+            # (in_axes=None — vmap treats it exactly like the previous
+            # closure capture, so the sync program is unchanged)
+            stacked_base = base_params is not None
+            base_p_in = base_params if stacked_base else server.params
+            base_a_in = base_aux if stacked_base else server.aux
+            base_ax = 0 if stacked_base else None
             payloads, deltas, new_on_clients, (losses, accs) = jax.vmap(
-                client_round)(on_clients, on_x, on_y, on_vx, on_vy,
-                              on_sizes, on_vsizes, weights, rngs,
-                              plan.budget_scale)
+                client_round,
+                in_axes=(0,) * 10 + (base_ax, base_ax)
+            )(on_clients, on_x, on_y, on_vx, on_vy,
+              on_sizes, on_vsizes, weights, rngs,
+              plan.budget_scale, base_p_in, base_a_in)
 
         # poison chaos: the client's UPLOAD goes non-finite (its local
         # state stays sane — the fault is at the wire, so ``deltas``
@@ -607,15 +659,12 @@ class FederatedTrainer:
         # server step and client_post see the same (e.g. re-quantized) sum
         payload_sum = jax.tree.map(lambda p: jnp.sum(p, axis=0), payloads)
         if accept is not None:
-            w_total = jnp.sum(weights)
-            w_accept = jnp.sum(weights * accept)
-            # all-rejected rounds contribute a zero payload (server holds)
-            renorm = jnp.where(w_accept > 0.0,
-                               w_total / jnp.maximum(w_accept, 1e-12), 0.0)
-            payload_sum = jax.tree.map(
-                lambda p: p * renorm.astype(p.dtype)
-                if jnp.issubdtype(p.dtype, jnp.floating) else p,
-                payload_sum)
+            # rejected weight redistributed over survivors; all-rejected
+            # rounds contribute a zero payload (server holds). Staleness
+            # weights (weight_scale) are already composed into
+            # ``weights``, so they renormalize with it (guards.py).
+            payload_sum = renormalize_accepted(payload_sum, weights,
+                                               accept)
         payload_sum = alg.aggregate_transform(payload_sum)
 
         new_params, new_opt, new_saux = alg.server_update(
@@ -648,12 +697,12 @@ class FederatedTrainer:
             aux=post_aux,
             # clients leave the round holding the aggregated server model
             # (model_server = deepcopy(model_client), fedavg.py:97)
-            params=jax.vmap(lambda _: new_params)(jnp.arange(self.k_online)))
+            params=jax.vmap(lambda _: new_params)(jnp.arange(k)))
 
         # crash chaos: a crashed client's round never happened on its
         # side — state rolls back to round start, and it reports no
         # metrics (it is not online this round)
-        online = jnp.ones((self.k_online,))
+        online = jnp.ones((k,))
         if flt.client_drop_rate > 0.0:
             new_on_clients = tree_where(plan.survive, new_on_clients,
                                         on_clients0)
@@ -668,11 +717,11 @@ class FederatedTrainer:
         loss_full = jnp.zeros((C,)).at[idx].set(losses * online)
         acc_full = jnp.zeros((C,)).at[idx].set(accs * online)
         comm_bytes = jnp.asarray(
-            tree_bytes(server.params) * self.k_online
+            tree_bytes(server.params) * k
             * alg.payload_scale(), jnp.float32)
         if flt.client_drop_rate > 0.0:
             # crashed uploads never hit the wire
-            comm_bytes = comm_bytes * jnp.sum(online) / self.k_online
+            comm_bytes = comm_bytes * jnp.sum(online) / k
 
         new_server = ServerState(params=new_params, opt=new_opt,
                                  aux=new_saux, round=server.round + 1,
@@ -683,7 +732,7 @@ class FederatedTrainer:
         metrics = RoundMetrics(
             train_loss=loss_full, train_acc=acc_full,
             online_mask=mask_full, comm_bytes=comm_bytes,
-            dropped_clients=self.k_online - jnp.sum(online),
+            dropped_clients=k - jnp.sum(online),
             straggler_clients=jnp.sum(
                 (plan.budget_scale < 1.0).astype(jnp.float32)),
             rejected_updates=jnp.asarray(rejected, jnp.float32),
@@ -873,6 +922,9 @@ class FederatedTrainer:
             "stragglers": metrics.straggler_clients,
             "rejected": metrics.rejected_updates,
             "clipped": metrics.clipped_updates,
+            # async commit plane: mean snapshot staleness this commit
+            # consumed (0.0 on the sync planes) — riding the same fetch
+            "staleness": metrics.staleness_mean,
         }
         if self._stop_signal is not None:
             out["stop"] = self.stop_flag_dev(bool(self._stop_signal()))

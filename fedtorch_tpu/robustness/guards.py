@@ -39,6 +39,30 @@ class GuardReport(NamedTuple):
     norms: jnp.ndarray     # [k] per-client delta l2 norm (NaN if !finite)
 
 
+def renormalize_accepted(payload_sum, weights, accept):
+    """Rescale the aggregated payload so the ACCEPTED clients carry the
+    full round weight: rejected/crashed weight is redistributed over the
+    survivors, keeping the server step at its fault-free magnitude
+    (all-rejected rounds scale to 0 — the server holds).
+
+    ``weights`` are the COMPOSED per-client aggregation weights — the
+    algorithm's base weights times any staleness weighting the async
+    commit plane applied (``async_plane/staleness.py``) — so a rejected
+    stale update gives back exactly the (damped) weight it would have
+    contributed, and staleness weighting composes with guard
+    renormalization by construction. Single definition shared by the
+    engine's sync round and async commit paths
+    (``parallel/federated.py:_round_core``)."""
+    w_total = jnp.sum(weights)
+    w_accept = jnp.sum(weights * accept)
+    renorm = jnp.where(w_accept > 0.0,
+                       w_total / jnp.maximum(w_accept, 1e-12), 0.0)
+    return jax.tree.map(
+        lambda p: p * renorm.astype(p.dtype)
+        if jnp.issubdtype(p.dtype, jnp.floating) else p,
+        payload_sum)
+
+
 def client_delta_stats(deltas) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Per-client (finite, l2-norm) over a [k]-leading delta pytree.
     Non-float leaves (integer wire formats) are excluded from the norm

@@ -76,6 +76,10 @@ def _compat_meta(cfg: ExperimentConfig) -> dict:
         "num_epochs": cfg.train.num_epochs,
         "algorithm": cfg.effective_algorithm,
         "num_clients": cfg.federated.num_clients,
+        # the async plane wraps server.aux with the snapshot ring, so a
+        # sync/async mismatch is a STRUCTURAL incompatibility (it would
+        # otherwise surface as a silent corrupt-skip fresh start)
+        "sync_mode": cfg.federated.sync_mode,
     }
 
 
@@ -426,10 +430,13 @@ def maybe_resume(directory: Optional[str], server, clients,
     old = meta["arguments"]
     new = _compat_meta(cfg)
     for key in ("dataset", "batch_size", "arch", "algorithm",
-                "num_clients"):
-        if old[key] != new[key]:
+                "num_clients", "sync_mode"):
+        # pre-async checkpoints carry no sync_mode entry — they are all
+        # sync (the only mode that existed)
+        was = old.get(key, "sync") if key == "sync_mode" else old[key]
+        if was != new[key]:
             raise ValueError(
-                f"Checkpoint incompatible: {key} was {old[key]!r}, "
+                f"Checkpoint incompatible: {key} was {was!r}, "
                 f"config has {new[key]!r} (checkpoint.py:104-120 rule)")
     if new["num_epochs"] is not None and old["num_epochs"] is not None \
             and new["num_epochs"] < old["num_epochs"]:

@@ -31,10 +31,29 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def straggler_heavy_fault() -> dict:
+    """The straggler-heavy chaos schedule (FaultConfig kwargs): a
+    long-tail delay distribution — 40% of dispatches land in a 10x
+    tail. Under the SYNC planes these knobs cut straggler step budgets
+    (the deadline model); under the async commit plane the SAME knobs
+    draw the event scheduler's completion delays, so one preset drives
+    both sides of the async A/B (scripts/async_bench.py reuses it as
+    its chaos schedule). Returned as kwargs so importers can compose
+    it into a FaultConfig with guards/crashes of their own."""
+    return {"straggler_rate": 0.4, "straggler_step_frac": 0.1}
+
+
 def run_suite(rounds: int = 20, smoke: bool = False, tol_points: float = 5.0,
-              algorithms=("fedavg", "scaffold"), seed: int = 0) -> dict:
+              algorithms=("fedavg", "scaffold"), seed: int = 0,
+              straggler_heavy: bool = False) -> dict:
     """Returns the suite report; raises AssertionError on a tolerance
-    breach (the pytest wrapper surfaces it directly)."""
+    breach (the pytest wrapper surfaces it directly).
+
+    ``straggler_heavy=True`` switches the drill: instead of fault-free
+    vs chaos on the SYNC plane, each algorithm runs sync vs ASYNC
+    (``sync_mode='async'``) under the :func:`straggler_heavy_fault`
+    schedule — the ISSUE 6 convergence bar (async within ``tol_points``
+    of sync while its commit program traces exactly once)."""
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
         import jax
         jax.config.update("jax_platforms", "cpu")
@@ -50,26 +69,35 @@ def run_suite(rounds: int = 20, smoke: bool = False, tol_points: float = 5.0,
     from fedtorch_tpu.models import define_model
     from fedtorch_tpu.parallel import FederatedTrainer, evaluate
     from fedtorch_tpu.robustness import RoundSupervisor
+    from fedtorch_tpu.utils.tracing import RecompilationSentinel
 
     C = 8 if smoke else 16
     B = 16 if smoke else 32
     K = 3 if smoke else 5
     rounds = max(rounds, 4)
+    # async needs num_clients >= concurrency + buffer so every arrival
+    # has a distinct replacement; half-rate participation keeps the
+    # smoke shapes legal while leaving the sync leg a real cohort
+    online_rate = 0.5 if straggler_heavy else 1.0
 
     fault_schedule = FaultConfig(
         client_drop_rate=0.25, straggler_rate=0.25,
         straggler_step_frac=0.5, nan_inject_rate=0.1,
         guard_updates=True, max_retries=2, backoff_base_s=0.0)
+    if straggler_heavy:
+        fault_schedule = FaultConfig(**straggler_heavy_fault())
 
-    def one_run(algorithm: str, fault: FaultConfig):
+    def one_run(algorithm: str, fault: FaultConfig,
+                sync_mode: str = "sync", num_comms: int = None):
         cfg = ExperimentConfig(
             data=DataConfig(dataset="synthetic", synthetic_dim=30,
                             batch_size=B, synthetic_alpha=0.5,
                             synthetic_beta=0.5),
             federated=FederatedConfig(
-                federated=True, num_clients=C, num_comms=rounds,
-                online_client_rate=1.0, algorithm=algorithm,
-                sync_type="local_step"),
+                federated=True, num_clients=C,
+                num_comms=num_comms or rounds,
+                online_client_rate=online_rate, algorithm=algorithm,
+                sync_type="local_step", sync_mode=sync_mode),
             model=ModelConfig(arch="logistic_regression"),
             optim=OptimConfig(lr=0.5, weight_decay=0.0),
             train=TrainConfig(local_step=K),
@@ -77,16 +105,35 @@ def run_suite(rounds: int = 20, smoke: bool = False, tol_points: float = 5.0,
         ).finalize()
         data = build_federated_data(cfg)
         model = define_model(cfg, batch_size=B)
-        trainer = FederatedTrainer(cfg, model, make_algorithm(cfg),
-                                   data.train)
+        if sync_mode == "async":
+            from fedtorch_tpu.async_plane import AsyncFederatedTrainer
+            trainer = AsyncFederatedTrainer(cfg, model,
+                                            make_algorithm(cfg),
+                                            data.train)
+        else:
+            trainer = FederatedTrainer(cfg, model, make_algorithm(cfg),
+                                       data.train)
         server, clients = trainer.init_state(jax.random.key(seed))
         sup = RoundSupervisor(trainer, sleep_fn=lambda s: None)
-        counters = {"dropped": 0.0, "stragglers": 0.0, "rejected": 0.0}
-        for _ in range(rounds):
-            server, clients, m = sup.run_round(server, clients)
+        counters = {"dropped": 0.0, "stragglers": 0.0, "rejected": 0.0,
+                    "retraces": 0}
+        # first round/commit pays the (expected) trace; the sentinel
+        # then proves the program re-traces ZERO times — the async
+        # commit program is trace-once like every other plane
+
+        def count(m):
             counters["dropped"] += float(m.dropped_clients)
             counters["stragglers"] += float(m.straggler_clients)
             counters["rejected"] += float(m.rejected_updates)
+
+        server, clients, m = sup.run_round(server, clients)
+        count(m)
+        with RecompilationSentinel() as sentinel:
+            for _ in range(cfg.federated.num_comms - 1):
+                server, clients, m = sup.run_round(server, clients)
+                count(m)
+        counters["retraces"] = sum(sentinel.counts.values())
+        trainer.invalidate_stream()
         assert all(bool(jnp.all(jnp.isfinite(x)))
                    for x in jax.tree.leaves(server.params)), \
             f"{algorithm}: non-finite server params survived the guards"
@@ -94,11 +141,46 @@ def run_suite(rounds: int = 20, smoke: bool = False, tol_points: float = 5.0,
         return float(res.top1), counters, sup.stats
 
     report = {"rounds": rounds, "clients": C, "tol_points": tol_points,
-              "fault": {"client_drop_rate": 0.25, "straggler_rate": 0.25,
-                        "nan_inject_rate": 0.1, "guard": "reject"},
+              "fault": straggler_heavy_fault() if straggler_heavy else
+              {"client_drop_rate": 0.25, "straggler_rate": 0.25,
+               "nan_inject_rate": 0.1, "guard": "reject"},
+              "mode": "straggler_heavy_sync_vs_async"
+              if straggler_heavy else "clean_vs_chaos",
               "algorithms": {}}
     t0 = time.time()
     for algorithm in algorithms:
+        if straggler_heavy:
+            # the async convergence bar: sync vs async under the same
+            # long-tail schedule, equal CLIENT-UPDATE budget (R sync
+            # rounds aggregate k updates each; the async buffer holds
+            # m = k // 2, so it commits twice as often)
+            sync_acc, _, _ = one_run(algorithm, fault_schedule, "sync")
+            k = max(int(online_rate * C), 1)
+            commits = rounds * k // max(k // 2, 1)
+            async_acc, counters, stats = one_run(
+                algorithm, fault_schedule, "async", num_comms=commits)
+            gap = (sync_acc - async_acc) * 100.0
+            entry = {
+                "sync_top1": round(sync_acc, 4),
+                "async_top1": round(async_acc, 4),
+                "gap_points": round(gap, 2),
+                "async_commits": commits,
+                "async_stragglers": int(counters["stragglers"]),
+                "commit_retraces": counters["retraces"],
+            }
+            report["algorithms"][algorithm] = entry
+            log(f"{algorithm}: sync {sync_acc:.4f} async {async_acc:.4f}"
+                f" gap {gap:+.2f}pts over {commits} commits "
+                f"({entry['async_stragglers']} stragglers)")
+            assert counters["stragglers"] > 0, \
+                f"{algorithm}: straggler-heavy schedule delayed nothing"
+            assert counters["retraces"] == 0, (
+                f"{algorithm}: async commit program retraced "
+                f"{counters['retraces']}x mid-run (trace-once bar)")
+            assert gap <= tol_points, (
+                f"{algorithm}: async lost {gap:.2f} accuracy points vs "
+                f"sync (tolerance {tol_points}); ISSUE 6 regression")
+            continue
         clean_acc, _, _ = one_run(algorithm, FaultConfig())
         chaos_acc, counters, stats = one_run(algorithm, fault_schedule)
         gap = (clean_acc - chaos_acc) * 100.0
@@ -106,7 +188,8 @@ def run_suite(rounds: int = 20, smoke: bool = False, tol_points: float = 5.0,
             "clean_top1": round(clean_acc, 4),
             "chaos_top1": round(chaos_acc, 4),
             "gap_points": round(gap, 2),
-            "faults_injected": {k: int(v) for k, v in counters.items()},
+            "faults_injected": {k: int(v) for k, v in counters.items()
+                                if k != "retraces"},
             "supervisor": {"rollbacks": stats.rollbacks,
                            "skipped_rounds": stats.skipped_rounds},
         }
@@ -204,9 +287,15 @@ def main():
     ap.add_argument("--kill-drill", action="store_true",
                     help="also run the process-lifecycle kill drill "
                          "(SIGTERM -> exit 75 -> relaunch -> complete)")
+    ap.add_argument("--straggler-heavy", action="store_true",
+                    help="long-tail delay preset: compare SYNC vs "
+                         "ASYNC (sync_mode='async') under the "
+                         "straggler-heavy schedule instead of clean "
+                         "vs chaos (the ISSUE 6 convergence bar)")
     args = ap.parse_args()
     report = run_suite(rounds=args.rounds, smoke=args.smoke,
-                       tol_points=args.tol, seed=args.seed)
+                       tol_points=args.tol, seed=args.seed,
+                       straggler_heavy=args.straggler_heavy)
     if args.kill_drill:
         report["kill_drill"] = run_kill_drill(
             rounds=60 if args.smoke else 150)

@@ -16,6 +16,10 @@
 #   stream           scripts/stream_bench.py      -> STREAM_AB.json
 #                        (device vs stream wall-time + bytes moved +
 #                         residency + retrace count on the real chip)
+#   async            scripts/async_bench.py       -> ASYNC_AB.json
+#                        (sync round clock vs FedBuff-style commit
+#                         clock under the straggler-heavy schedule +
+#                         on-chip ms/commit + accuracy parity)
 #   conv-ab          BENCH_CONV_IMPL=matmul|conv  (lowering A/B, both)
 #   zoo              scripts/tpu_zoo_check.py     -> TPU_ZOO.json
 #   pallas           scripts/pallas_tpu_check.py  -> PALLAS_TPU.json
@@ -51,8 +55,8 @@ TRIES="${TPU_CAPTURE_WAIT_TRIES:-90}"   # ~6 h of patience by default
 # mfu leads: round 6 is the utilization round — the fused-vs-base A/B
 # and the first-ever on-chip traces are the highest-value capture if
 # the relay wedges mid-list
-DEFAULT_STEPS="mfu stream bench-streaming bench-dispatch bench-unroll \
-bench zoo pallas flash-train vmap baseline"
+DEFAULT_STEPS="mfu stream async bench-streaming bench-dispatch \
+bench-unroll bench zoo pallas flash-train vmap baseline"
 STEPS="${*:-$DEFAULT_STEPS}"
 
 echo "[tpu_capture] waiting for the relay (up to ${TRIES}x120s probes)"
@@ -70,6 +74,7 @@ for step in $STEPS; do
         bench-dispatch) run env BENCH_SINGLE_DISPATCH=0 python bench.py ;;
         bench-streaming) run env BENCH_STREAMING=1 python bench.py ;;
         stream)         run python scripts/stream_bench.py ;;
+        async)          run python scripts/async_bench.py ;;
         conv-ab)        run env BENCH_CONV_IMPL=matmul python bench.py
                         run env BENCH_CONV_IMPL=conv python bench.py ;;
         zoo)            run python scripts/tpu_zoo_check.py ;;

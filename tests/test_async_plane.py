@@ -1,0 +1,381 @@
+"""Async commit plane (fedtorch_tpu.async_plane) — fast-lane tests.
+
+Covers the ISSUE 6 test satellites: staleness-weight math (const/poly/
+inv, weight 1 at staleness 0, composition with the guard
+renormalization), the deterministic event scheduler (same seed →
+identical commit sequences, fast-forward == stepped, ring clamping,
+tail-independence of the commit clock), trainer-level bitwise
+determinism and device/stream parity, the trace-once sentinel on the
+commit program, and checkpoint-resume bitwise parity. The sync-vs-async
+CONVERGENCE bar runs in the slow lane (tests/test_chaos_suite.py
+straggler-heavy case); the CLI drain drill extends
+tests/test_preemption.py.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedtorch_tpu.algorithms import make_algorithm
+from fedtorch_tpu.async_plane import (
+    ASYNC_ALGORITHMS, AsyncFederatedTrainer,
+)
+from fedtorch_tpu.async_plane.scheduler import (
+    AsyncSchedule, simulate_sync_round_times,
+)
+from fedtorch_tpu.async_plane.staleness import (
+    STALENESS_MODES, normalized_staleness_weights, staleness_weight,
+)
+from fedtorch_tpu.config import (
+    CheckpointConfig, DataConfig, ExperimentConfig, FaultConfig,
+    FederatedConfig, ModelConfig, OptimConfig, TrainConfig,
+)
+from fedtorch_tpu.data import build_federated_data
+from fedtorch_tpu.models import define_model
+from fedtorch_tpu.robustness.guards import renormalize_accepted
+from fedtorch_tpu.utils.tracing import RecompilationSentinel
+
+STRAGGLER_HEAVY = {"straggler_rate": 0.4, "straggler_step_frac": 0.1}
+
+
+def make_cfg(algorithm="fedavg", plane="device", sync_mode="async",
+             num_clients=12, num_comms=4, fault_kw=None, fed_kw=None,
+             **ckpt_kw):
+    return ExperimentConfig(
+        data=DataConfig(dataset="synthetic", synthetic_dim=10,
+                        batch_size=8, data_plane=plane),
+        federated=FederatedConfig(
+            federated=True, num_clients=num_clients,
+            num_comms=num_comms, online_client_rate=0.5,
+            algorithm=algorithm, sync_type="local_step",
+            sync_mode=sync_mode, **(fed_kw or {})),
+        model=ModelConfig(arch="logistic_regression"),
+        optim=OptimConfig(lr=0.5, weight_decay=0.0),
+        train=TrainConfig(local_step=2),
+        fault=FaultConfig(**(fault_kw if fault_kw is not None
+                             else STRAGGLER_HEAVY)),
+        checkpoint=CheckpointConfig(**ckpt_kw),
+    ).finalize()
+
+
+def make_trainer(cfg):
+    data = build_federated_data(cfg)
+    model = define_model(cfg, batch_size=cfg.data.batch_size)
+    cls = AsyncFederatedTrainer if cfg.federated.sync_mode == "async" \
+        else __import__("fedtorch_tpu.parallel",
+                        fromlist=["FederatedTrainer"]).FederatedTrainer
+    return cls(cfg, model, make_algorithm(cfg), data.train)
+
+
+def run_commits(trainer, n, seed=0, collect=False):
+    server, clients = trainer.init_state(jax.random.key(seed))
+    traj = []
+    for _ in range(n):
+        server, clients, m = trainer.run_round(server, clients)
+        if collect:
+            traj.append(np.concatenate([
+                np.ravel(x) for x in jax.tree.leaves(
+                    jax.device_get(server.params))]))
+    trainer.invalidate_stream()
+    return server, clients, m, traj
+
+
+# -- staleness weights -------------------------------------------------------
+class TestStalenessWeights:
+    def test_weight_is_one_at_zero_staleness(self):
+        for mode in STALENESS_MODES:
+            w = staleness_weight(jnp.zeros(4), mode, exponent=0.5)
+            np.testing.assert_array_equal(np.asarray(w), np.ones(4))
+
+    def test_shapes_hand_computed(self):
+        tau = jnp.asarray([0.0, 1.0, 3.0])
+        np.testing.assert_array_equal(
+            np.asarray(staleness_weight(tau, "const")), np.ones(3))
+        np.testing.assert_allclose(
+            np.asarray(staleness_weight(tau, "poly", 0.5)),
+            [1.0, 2.0 ** -0.5, 0.5], rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(staleness_weight(tau, "inv")),
+            [1.0, 0.5, 0.25], rtol=1e-6)
+        # inv is poly at exponent 1 — one family
+        np.testing.assert_allclose(
+            np.asarray(staleness_weight(tau, "inv")),
+            np.asarray(staleness_weight(tau, "poly", 1.0)), rtol=1e-6)
+
+    def test_normalized_mean_is_one(self):
+        tau = jnp.asarray([0.0, 2.0, 5.0, 1.0])
+        for mode in STALENESS_MODES:
+            w = normalized_staleness_weights(tau, mode, 0.5)
+            assert float(jnp.mean(w)) == pytest.approx(1.0, rel=1e-6)
+
+    def test_all_fresh_commit_reproduces_sync_weighting(self):
+        # tau == 0 everywhere → multiplier exactly 1: the async
+        # aggregation degenerates to the sync round's
+        for mode in STALENESS_MODES:
+            w = normalized_staleness_weights(jnp.zeros(5), mode)
+            np.testing.assert_array_equal(np.asarray(w), np.ones(5))
+
+    def test_unknown_mode_raises(self):
+        with pytest.raises(ValueError, match="staleness_weight"):
+            staleness_weight(jnp.zeros(2), "linear")
+
+    def test_composes_with_guard_renormalization(self):
+        """A REJECTED stale update hands back exactly its DAMPED
+        weight: the renormalization operates on the composed weights
+        (base x staleness), hand-computed here."""
+        base = jnp.asarray([0.25, 0.25, 0.5])
+        scale = normalized_staleness_weights(
+            jnp.asarray([0.0, 4.0, 1.0]), "inv")
+        weights = base * scale
+        accept = jnp.asarray([1.0, 0.0, 1.0])  # reject the stale one
+        payload_sum = {"w": jnp.asarray([2.0])}
+        out = renormalize_accepted(payload_sum, weights, accept)
+        expected = 2.0 * float(jnp.sum(weights)) / float(
+            jnp.sum(weights * accept))
+        assert float(out["w"][0]) == pytest.approx(expected, rel=1e-6)
+        # and the damped weight is genuinely smaller than the fresh
+        # one would have been — rejecting a stale update costs less
+        assert float(weights[1]) < float(base[1])
+
+
+# -- the event scheduler -----------------------------------------------------
+def _sched(start_commit=0, ring=8, num_clients=16, concurrency=6,
+           buffer_size=3, seed=7, **kw):
+    key = jax.random.key(seed)
+    key_data = np.asarray(jax.device_get(jax.random.key_data(key)))
+    return AsyncSchedule(
+        key_data, jax.random.key_impl(key), num_clients=num_clients,
+        concurrency=concurrency, buffer_size=buffer_size,
+        ring_size=ring, start_commit=start_commit,
+        **{**STRAGGLER_HEAVY, **kw})
+
+
+class TestAsyncSchedule:
+    def test_same_seed_identical_commit_sequence(self):
+        a, b = _sched(), _sched()
+        for _ in range(6):
+            pa, pb = a.next_commit(), b.next_commit()
+            assert pa.commit == pb.commit
+            np.testing.assert_array_equal(pa.idx, pb.idx)
+            np.testing.assert_array_equal(pa.version, pb.version)
+            np.testing.assert_array_equal(pa.dispatch, pb.dispatch)
+            np.testing.assert_array_equal(pa.arrival_times,
+                                          pb.arrival_times)
+
+    def test_fast_forward_equals_stepped(self):
+        """start_commit=N is the resume path: a fresh instance
+        fast-forwarded to commit N must continue exactly like the
+        original instance that lived through commits 0..N-1."""
+        live = _sched()
+        for _ in range(4):
+            live.next_commit()
+        resumed = _sched(start_commit=4)
+        for _ in range(3):
+            pl, pr = live.next_commit(), resumed.next_commit()
+            assert pl.commit == pr.commit
+            np.testing.assert_array_equal(pl.idx, pr.idx)
+            np.testing.assert_array_equal(pl.version, pr.version)
+            np.testing.assert_array_equal(pl.dispatch, pr.dispatch)
+
+    def test_commit_plan_invariants(self):
+        s = _sched()
+        for expected_commit in range(5):
+            p = s.next_commit()
+            assert p.commit == expected_commit
+            # distinct clients, all in range
+            assert len(set(p.idx.tolist())) == len(p.idx)
+            assert (p.idx >= 0).all() and (p.idx < 16).all()
+            # no update trains on the future; arrivals are ordered
+            assert (p.version <= p.commit).all()
+            assert (np.diff(p.arrival_times) >= 0).all()
+            assert p.commit_time == p.arrival_times[-1]
+
+    def test_ring_clamp_counted(self):
+        """A 2-deep ring under a heavy tail must clamp some arrivals
+        to the oldest retained snapshot (and count them)."""
+        s = _sched(ring=2)
+        for _ in range(12):
+            p = s.next_commit()
+            assert (p.version >= max(p.commit - 1, 0)).all()
+        assert s.stats.staleness_clamped > 0
+
+    def test_stats_count_stragglers(self):
+        s = _sched()
+        for _ in range(8):
+            s.next_commit()
+        st = s.stats
+        assert st.dispatches >= 6 + 8 * 3  # cohort + replacements
+        assert 0 < st.stragglers < st.dispatches
+
+    def test_commit_clock_not_gated_on_tail(self):
+        """The A/B's claim at scheduler level: under the same delay
+        model, the async commit interval (fastest m of the in-flight
+        cohort) beats the sync round interval (max over k)."""
+        s = _sched()
+        n = 20
+        for _ in range(n):
+            s.next_commit()
+        commit_dt = s.commit_times[-1] / n
+        key = jax.random.key(7)
+        rounds = simulate_sync_round_times(
+            np.asarray(jax.device_get(jax.random.key_data(key))),
+            jax.random.key_impl(key), rounds=n, k_online=6,
+            **STRAGGLER_HEAVY)
+        assert commit_dt < float(np.mean(rounds))
+
+    def test_population_guard(self):
+        with pytest.raises(ValueError, match="num_clients"):
+            _sched(num_clients=8, concurrency=6, buffer_size=3)
+
+
+# -- the trainer -------------------------------------------------------------
+class TestAsyncTrainer:
+    def test_same_seed_bitwise_commit_sequence(self):
+        cfg = make_cfg()
+        t1, t2 = make_trainer(cfg), make_trainer(cfg)
+        *_, a = run_commits(t1, 4, collect=True)
+        *_, b = run_commits(t2, 4, collect=True)
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+
+    @pytest.mark.parametrize("algorithm", ["fedavg", "scaffold"])
+    def test_device_stream_parity_bitwise(self, algorithm):
+        """The two async data planes run the same commit program —
+        the host feed producer replays the device row plan exactly."""
+        td = make_trainer(make_cfg(algorithm, plane="device"))
+        ts = make_trainer(make_cfg(algorithm, plane="stream"))
+        *_, a = run_commits(td, 4, collect=True)
+        *_, b = run_commits(ts, 4, collect=True)
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+
+    def test_staleness_metric_reported(self):
+        tr = make_trainer(make_cfg())
+        _, _, m, _ = run_commits(tr, 2)
+        assert float(m.staleness_mean) > 0.0
+        assert float(m.straggler_clients) >= 0.0
+
+    def test_sync_plane_reports_zero_staleness(self):
+        cfg = make_cfg(sync_mode="sync", fault_kw={})
+        tr = make_trainer(cfg)
+        _, _, m, _ = run_commits(tr, 2)
+        assert float(jnp.asarray(m.staleness_mean)) == 0.0
+
+    def test_commit_program_traces_once(self):
+        tr = make_trainer(make_cfg(num_comms=4))
+        server, clients = tr.init_state(jax.random.key(0))
+        with RecompilationSentinel() as s:
+            for _ in range(4):
+                server, clients, _ = tr.run_round(server, clients)
+        tr.invalidate_stream()
+        s.assert_traces(tr.commit_trace_name, expected=1)
+
+    def test_resumed_run_matches_uninterrupted_bitwise(self, tmp_path):
+        """Kill-drill core (in-process): checkpoint at commit 3,
+        rebuild everything from disk, run 3 more — the stitched
+        trajectory must equal the uninterrupted 6-commit run bitwise
+        (the scheduler fast-forwards its event simulation to the
+        checkpoint's commit)."""
+        from fedtorch_tpu.utils import maybe_resume, save_checkpoint
+
+        cfg = make_cfg(num_comms=6)
+        ref, *_ = run_commits(make_trainer(cfg), 6)
+
+        tr = make_trainer(cfg)
+        server, clients = tr.init_state(jax.random.key(0))
+        for _ in range(3):
+            server, clients, _ = tr.run_round(server, clients)
+        save_checkpoint(str(tmp_path), server, clients, cfg, 0.0, False)
+        tr.invalidate_stream()
+        del tr, server, clients
+
+        tr2 = make_trainer(cfg)
+        server, clients = tr2.init_state(jax.random.key(0))
+        server, clients, _, resumed = maybe_resume(
+            str(tmp_path), server, clients, cfg)
+        assert resumed and int(jax.device_get(server.round)) == 3
+        for _ in range(3):
+            server, clients, _ = tr2.run_round(server, clients)
+        tr2.invalidate_stream()
+        assert int(jax.device_get(server.round)) == 6
+        for a, b in zip(jax.tree.leaves(ref.params),
+                        jax.tree.leaves(server.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_supervisor_rollback_resyncs_scheduler(self):
+        """invalidate_stream (the supervisor's rollback hook) drops
+        the event scheduler; the next commit rebuilds it from the live
+        (rng, round) state and the trajectory continues unchanged."""
+        cfg = make_cfg(num_comms=4)
+        ref, *_ = run_commits(make_trainer(cfg), 4)
+        tr = make_trainer(cfg)
+        server, clients = tr.init_state(jax.random.key(0))
+        for i in range(4):
+            server, clients, _ = tr.run_round(server, clients)
+            if i == 1:
+                tr.invalidate_stream()  # mid-run resync
+        tr.invalidate_stream()
+        for a, b in zip(jax.tree.leaves(ref.params),
+                        jax.tree.leaves(server.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# -- config / checkpoint surface ---------------------------------------------
+class TestAsyncConfigSurface:
+    def test_sync_mode_validated(self):
+        with pytest.raises(ValueError, match="sync_mode"):
+            make_cfg(sync_mode="buffered")
+
+    def test_async_requires_federated(self):
+        with pytest.raises(ValueError, match="federated=True"):
+            ExperimentConfig(
+                federated=FederatedConfig(federated=False,
+                                          sync_mode="async"),
+            ).finalize()
+
+    def test_knob_validation(self):
+        with pytest.raises(ValueError, match="staleness_exponent"):
+            make_cfg(fed_kw={"staleness_exponent": 0.0})
+        with pytest.raises(ValueError, match="snapshot_ring"):
+            make_cfg(fed_kw={"snapshot_ring": 1})
+        with pytest.raises(ValueError, match="async_buffer_size"):
+            make_cfg(fed_kw={"async_buffer_size": -1})
+        with pytest.raises(ValueError, match="staleness_weight"):
+            make_cfg(fed_kw={"staleness_weight": "exp"})
+
+    def test_cli_flags_map(self):
+        from fedtorch_tpu.cli import args_to_config, build_parser
+        cfg = args_to_config(build_parser().parse_args([
+            "--federated", "true", "-d", "synthetic", "-a",
+            "logistic_regression", "--sync_mode", "async",
+            "--async_buffer_size", "4", "--async_concurrency", "9",
+            "--staleness_weight", "inv", "--staleness_exponent", "0.7",
+            "--snapshot_ring", "5"]))
+        fed = cfg.federated
+        assert fed.sync_mode == "async"
+        assert fed.async_buffer_size == 4
+        assert fed.async_concurrency == 9
+        assert fed.staleness_weight == "inv"
+        assert fed.staleness_exponent == 0.7
+        assert fed.snapshot_ring == 5
+
+    def test_checkpoint_refuses_cross_plane_resume(self, tmp_path):
+        """A sync checkpoint must not silently resume an async run (the
+        ring wrap makes the aux STRUCTURALLY different): the compat
+        check names sync_mode."""
+        from fedtorch_tpu.utils import maybe_resume, save_checkpoint
+
+        cfg = make_cfg(sync_mode="sync", fault_kw={})
+        tr = make_trainer(cfg)
+        server, clients = tr.init_state(jax.random.key(0))
+        save_checkpoint(str(tmp_path), server, clients, cfg, 0.0, False)
+
+        acfg = make_cfg(sync_mode="async", fault_kw={})
+        tr2 = make_trainer(acfg)
+        server2, clients2 = tr2.init_state(jax.random.key(0))
+        with pytest.raises(ValueError, match="sync_mode"):
+            maybe_resume(str(tmp_path), server2, clients2, acfg)
+
+    def test_async_algorithms_registry(self):
+        assert set(ASYNC_ALGORITHMS) == {
+            "fedavg", "fedprox", "fedadam", "scaffold"}

@@ -30,3 +30,18 @@ def test_kill_drill_lifecycle(tmp_path):
     report = run_kill_drill(rounds=60, ckpt_root=str(tmp_path))
     assert report["launches"] >= 2
     assert report["final_round"] == 60
+
+
+@pytest.mark.slow
+def test_straggler_heavy_async_within_tolerance():
+    """ISSUE 6 convergence bar: FedAvg + SCAFFOLD on the async commit
+    plane stay within 5 points of the sync plane under the
+    straggler-heavy (long-tail delay) schedule, with the commit
+    program tracing exactly once."""
+    from chaos_suite import run_suite
+    report = run_suite(rounds=8, smoke=True, tol_points=5.0,
+                       straggler_heavy=True)
+    for algorithm, entry in report["algorithms"].items():
+        assert entry["gap_points"] <= 5.0
+        assert entry["async_stragglers"] > 0
+        assert entry["commit_retraces"] == 0

@@ -249,3 +249,88 @@ class TestMLPEngine:
         server, clients = trainer.init_state(jax.random.key(0))
         server, clients, metrics = trainer.run_round(server, clients)
         assert np.isfinite(float(jnp.sum(metrics.train_loss)))
+
+
+class TestAsyncGateMatrix:
+    """ISSUE 6 satellite: every unsupported combination of
+    `--sync_mode async` must raise ONE clear ValueError naming the
+    gate at construction (the stream-plane gate style) — never fail
+    deep in tracing."""
+
+    def _async_cfg(self, algorithm="fedavg", num_clients=12, rate=0.5,
+                   mesh_kw=None, **fed_kw):
+        from fedtorch_tpu.config import MeshConfig
+        return ExperimentConfig(
+            data=DataConfig(dataset="synthetic", synthetic_dim=20,
+                            batch_size=32, synthetic_alpha=0.5,
+                            synthetic_beta=0.5),
+            federated=FederatedConfig(
+                federated=True, num_clients=num_clients, num_comms=4,
+                online_client_rate=rate, algorithm=algorithm,
+                sync_type="local_step", sync_mode="async", **fed_kw),
+            model=ModelConfig(arch="logistic_regression"),
+            optim=OptimConfig(lr=0.1, weight_decay=0.0),
+            train=TrainConfig(local_step=2),
+            mesh=MeshConfig(**(mesh_kw or {})),
+        ).finalize()
+
+    def _build(self, cfg, **kw):
+        from fedtorch_tpu.async_plane import AsyncFederatedTrainer
+        data = build_federated_data(cfg)
+        model = define_model(cfg, batch_size=cfg.data.batch_size)
+        return AsyncFederatedTrainer(cfg, model, make_algorithm(cfg),
+                                     data.train, **kw)
+
+    @pytest.mark.parametrize("algorithm", [
+        "fedgate", "afl", "qffl", "qsparse", "apfl", "perfedme",
+        "perfedavg"])
+    def test_gated_algorithms_raise_named_gate(self, algorithm):
+        cfg = self._async_cfg(algorithm=algorithm)
+        with pytest.raises(ValueError,
+                           match="sync_mode='async' is unsupported"):
+            self._build(cfg)
+
+    def test_drfa_wrapper_gated(self):
+        cfg = self._async_cfg(algorithm="fedavg", drfa=True)
+        with pytest.raises(ValueError, match="drfa"):
+            self._build(cfg)
+
+    @pytest.mark.parametrize("algorithm", [
+        "fedavg", "fedprox", "fedadam", "scaffold"])
+    def test_supported_algorithms_construct(self, algorithm):
+        cfg = self._async_cfg(algorithm=algorithm)
+        self._build(cfg)  # must not raise
+
+    def test_fused_client_fusion_gated(self):
+        cfg = self._async_cfg(mesh_kw={"client_fusion": "fused"})
+        with pytest.raises(ValueError, match="client_fusion"):
+            self._build(cfg)
+
+    def test_shard_gather_gated(self):
+        cfg = self._async_cfg()
+        with pytest.raises(ValueError, match="shard"):
+            self._build(cfg, gather_mode="shard")
+
+    def test_buffer_exceeding_concurrency_gated(self):
+        cfg = self._async_cfg(async_buffer_size=5, async_concurrency=2)
+        with pytest.raises(ValueError, match="async_buffer_size"):
+            self._build(cfg)
+
+    def test_too_small_population_gated(self):
+        cfg = self._async_cfg(num_clients=6, rate=1.0)
+        with pytest.raises(ValueError, match="num_clients"):
+            self._build(cfg)
+
+    def test_base_trainer_refuses_async_config(self):
+        cfg = self._async_cfg()
+        data = build_federated_data(cfg)
+        model = define_model(cfg, batch_size=cfg.data.batch_size)
+        with pytest.raises(ValueError, match="AsyncFederatedTrainer"):
+            FederatedTrainer(cfg, model, make_algorithm(cfg),
+                             data.train)
+
+    def test_run_rounds_refused_on_async_plane(self):
+        trainer = self._build(self._async_cfg())
+        server, clients = trainer.init_state(jax.random.key(0))
+        with pytest.raises(ValueError, match="run_rounds"):
+            trainer.run_rounds(server, clients, 2)

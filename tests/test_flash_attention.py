@@ -123,21 +123,70 @@ class TestBackendSelection:
         jax.jit(fwd).trace(q, k, v).lower(lowering_platforms=("tpu",))
 
     def test_default_blocks_follow_measured_winners(self):
-        """Block defaults come from the on-chip sweep
-        (FLASH_BLOCK_SWEEP.json): (256, 512) at T<=2048, (512, 512)
-        above; explicit args override; divisor adjustment still
-        applies (T=256 -> one 256-block)."""
+        """Block defaults, settled per ADVICE r5: the TRAINING A/B
+        (FLASH_TRAIN.json) regressed 0.68x at T=2048 on the sweep-
+        derived (256, 512), so T<=2048 keeps the previously-validated
+        (128, 128); the forward sweep's (512, 512) stands at T>=4096.
+        Explicit args override; divisor adjustment still applies."""
         import fedtorch_tpu.ops.pallas.flash_attention as fa
 
-        assert fa._default_blocks(1024) == (256, 512)
-        assert fa._default_blocks(2048) == (256, 512)
+        assert fa._default_blocks(1024) == (128, 128)
+        assert fa._default_blocks(2048) == (128, 128)  # 0.68x window
         assert fa._default_blocks(4096) == (512, 512)
+        assert fa._default_blocks(8192) == (512, 512)
 
         q, k, v = _qkv(T=256, D=16)
         *_, bq, bk, _ = fa._prep(q, k, v, None, None, None, None)
-        assert (bq, bk) == (256, 256)  # defaults clamped to divisors
+        assert (bq, bk) == (128, 128)  # the validated sub-2048 shape
         *_, bq, bk, _ = fa._prep(q, k, v, None, 64, 64, None)
         assert (bq, bk) == (64, 64)    # explicit args respected
+        q, k, v = _qkv(T=96, D=16)     # T below the default block
+        *_, bq, bk, _ = fa._prep(q, k, v, None, None, None, None)
+        assert (bq, bk) == (96, 96)    # clamped to one block
+
+    def test_lse_output_is_lane_narrow(self):
+        """ADVICE r5 satellite: the lse HBM output is [BH, T, 8]
+        (_LSE_LANES), not the 128-lane broadcast — 16x less lse HBM
+        traffic. The narrowed write must still carry the exact lse:
+        interpret-mode kernel lse == dense-oracle lse, and the full
+        forward stays exact. (The Mosaic acceptance of the
+        (1, block_q, 8) block is pinned by
+        test_mosaic_lowering_accepts_blocks, which AOT-lowers the lse
+        variant for platform 'tpu'.)"""
+        import fedtorch_tpu.ops.pallas.flash_attention as fa
+        from fedtorch_tpu.ops.pallas.flash_attention import \
+            flash_attention_with_lse
+
+        assert fa._LSE_LANES == 8
+        # the narrow block satisfies the stated Mosaic rule by
+        # construction: last block dim == array dim
+        q, k, v = _qkv(T=256, D=32)
+        o_i, lse_i = flash_attention_with_lse(q, k, v, causal=True,
+                                              force="interpret")
+        o_x, lse_x = flash_attention_with_lse(q, k, v, causal=True,
+                                              force="xla")
+        np.testing.assert_allclose(np.asarray(lse_i),
+                                   np.asarray(lse_x),
+                                   atol=2e-5, rtol=2e-5)
+        np.testing.assert_allclose(np.asarray(o_i), np.asarray(o_x),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_lse_kernel_shape_is_narrow(self):
+        """The pallas forward's raw lse buffer really is 8 lanes (the
+        HBM allocation the advisor sized), independent of the wrapper
+        slicing."""
+        import fedtorch_tpu.ops.pallas.flash_attention as fa
+
+        def fwd(q3, k3, v3):
+            return fa._fwd_pallas(q3, k3, v3, 0.125, False, 64, 64,
+                                  interpret=True)
+
+        shapes = jax.eval_shape(
+            fwd, *(jax.ShapeDtypeStruct((4, 128, 32), jnp.float32)
+                   for _ in range(3)))
+        o_shape, lse_shape = shapes
+        assert o_shape.shape == (4, 128, 32)
+        assert lse_shape.shape == (4, 128)  # sliced from [*, *, 8]
 
     def test_degenerate_block_falls_back_to_xla(self, monkeypatch):
         """A prime-ish T collapses the divisor blocks to ~T; on TPU the
