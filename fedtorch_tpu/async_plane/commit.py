@@ -409,3 +409,34 @@ class AsyncFederatedTrainer(FederatedTrainer):
         """Scheduler counters (dispatches/stragglers/ring clamps) —
         None before the first commit."""
         return self._sched.stats if self._sched is not None else None
+
+    def telemetry_gauges(self) -> dict:
+        """Stream gauges (when on that plane) plus the async commit
+        plane's: buffer occupancy, scheduler dispatch/straggler/ring-
+        clamp counters, and the commit rate in virtual time units
+        (commits so far / last commit's virtual clock — the quantity
+        ASYNC_AB.json compares against the sync round clock). All host
+        counters; zero device syncs."""
+        out = super().telemetry_gauges()
+        sched = self._sched
+        if sched is None:
+            return out
+        st = sched.stats
+        ct = sched.commit_times
+        out.update({
+            "async_dispatches": float(st.dispatches),
+            "async_stragglers": float(st.stragglers),
+            "async_ring_clamped": float(st.staleness_clamped),
+            "async_buffer": float(self.buffer_size),
+            "async_commit_rate": (len(ct) / ct[-1])
+            if ct and ct[-1] > 0 else 0.0,
+        })
+        return out
+
+    def staleness_histogram(self):
+        """{commits-stale: count} over every committed update so far
+        (post ring-clamp) — emitted as one ``events.jsonl`` record at
+        drain/run-end rather than per-row (it is a dict, not a scalar
+        gauge)."""
+        return dict(self._sched.staleness_hist) \
+            if self._sched is not None else None

@@ -126,6 +126,12 @@ class AsyncSchedule:
         self._stragglers = 0
         self._clamped = 0
         self.commit_times: List[float] = []
+        # staleness histogram: {commits-stale: count} over every
+        # buffered update committed so far (post ring-clamp — the
+        # staleness the aggregation actually damped). Host-only
+        # telemetry (docs/observability.md); a fast-forwarded resume
+        # rebuilds it exactly, since the sim replays every commit.
+        self.staleness_hist: dict = {}
 
         # initial cohort: ``concurrency`` distinct clients against
         # version 0 at time 0
@@ -191,6 +197,9 @@ class AsyncSchedule:
         versions = np.asarray([v for _, _, _, v, _ in buffer], np.int64)
         clamped = np.maximum(versions, floor)
         self._clamped += int(np.sum(clamped != versions))
+        for s in (self._commit - clamped).tolist():
+            self.staleness_hist[int(s)] = \
+                self.staleness_hist.get(int(s), 0) + 1
         plan = HostCommitPlan(
             commit=self._commit,
             idx=np.asarray([c for _, _, c, _, _ in buffer], np.int32),

@@ -21,7 +21,7 @@ import time
 from fedtorch_tpu.config import (
     CheckpointConfig, DataConfig, ExperimentConfig, FaultConfig,
     FederatedConfig, LRConfig, MeshConfig, ModelConfig, OptimConfig,
-    TrainConfig,
+    TelemetryConfig, TrainConfig,
 )
 
 
@@ -316,6 +316,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "of TRAIN data as the test set; off by default "
                         "because it silently reports train accuracy "
                         "as test accuracy")
+    # observability (fedtorch_tpu.telemetry, docs/observability.md)
+    p.add_argument("--telemetry", default="default",
+                   choices=("off", "default", "debug"),
+                   help="run telemetry: 'default' writes schema-"
+                        "versioned metrics.jsonl/events.jsonl, a "
+                        "Perfetto-loadable trace.json of host spans, "
+                        "and the atomically-replaced health.json to "
+                        "the run dir (measured <= 1% round overhead, "
+                        "TELEMETRY_AB.json; zero added device syncs); "
+                        "'debug' re-exports the trace every 25 rounds; "
+                        "'off' disables everything "
+                        "(docs/observability.md)")
     return p
 
 
@@ -431,6 +443,7 @@ def args_to_config(args) -> ExperimentConfig:
             compute_dtype=args.compute_dtype,
             scan_unroll=args.scan_unroll, remat=args.remat,
             client_fusion=args.client_fusion),
+        telemetry=TelemetryConfig(level=args.telemetry),
         fault=FaultConfig(
             client_drop_rate=args.fault_client_drop_rate,
             straggler_rate=args.fault_straggler_rate,
@@ -507,79 +520,122 @@ def run_experiment(cfg: ExperimentConfig,
     logger.log(f"devices: {jax.devices()}")
     timer = PhaseTimer()
 
-    timer.start("data")
-    fed_data = build_federated_data(cfg, download=download)
-    model = define_model(cfg, batch_size=cfg.data.batch_size)
-    timer.stop("data")
+    # unified run telemetry (docs/observability.md): structured
+    # metrics/events + host spans + machine-readable health, written to
+    # the run dir. Host-only: every value it records is either a host
+    # counter or comes from the loop's ONE batched scalar fetch below —
+    # zero added device syncs, traced programs untouched.
+    from fedtorch_tpu.telemetry import Telemetry
+    tel = Telemetry(
+        ckpt_dir, level=cfg.telemetry.level,
+        process_index=jax.process_index(),
+        run_meta={
+            "algorithm": cfg.effective_algorithm,
+            "dataset": cfg.data.dataset, "arch": cfg.model.arch,
+            "sync_mode": cfg.federated.sync_mode,
+            "data_plane": cfg.data.data_plane,
+            "num_clients": cfg.federated.num_clients,
+            "num_comms": cfg.federated.num_comms,
+            "experiment": cfg.experiment,
+        },
+        max_span_events=cfg.telemetry.max_span_events)
+    tel.install()
+    tel.health_update("starting")
 
-    rng = jax.random.key(cfg.train.manual_seed)
+    # everything from data build through trainer/handler
+    # construction can raise (dataset IO, the async/stream
+    # gate matrix, resume incompatibility): the active
+    # telemetry must not leak past this run into a library
+    # caller's next one
+    try:
+        timer.start("data")
+        with tel.span("data.build"):
+            fed_data = build_federated_data(cfg, download=download)
+            model = define_model(cfg, batch_size=cfg.data.batch_size)
+        timer.stop("data")
 
-    if not cfg.federated.federated:
-        # local-SGD mode: flatten the per-worker shards back into one
-        # training set and IID-repartition across workers
-        import numpy as np
-        splits_x = np.asarray(fed_data.train.x).reshape(
-            (-1,) + fed_data.train.x.shape[2:])
-        splits_y = np.asarray(fed_data.train.y).reshape(-1)
-        trainer = build_local_sgd(cfg, model, splits_x, splits_y)
-        server, clients, history = trainer.fit(rng)
-        res = jax.device_get(evaluate(model, server.params,
-                                      fed_data.test_x, fed_data.test_y))
-        logger.log_val(len(history), "test", float(res.loss),
-                       float(res.top1), float(res.top5))
-        return {"test_top1": float(res.top1), "rounds": len(history)}
+        rng = jax.random.key(cfg.train.manual_seed)
 
-    algorithm = make_algorithm(cfg)
-    if cfg.federated.sync_mode == "async":
-        # the async commit plane (docs/robustness.md "Asynchronous
-        # federation"): run_round executes one COMMIT and server.round
-        # counts commit versions, so the loop below — checkpointing,
-        # eval cadence, preemption drain, supervisor — runs unchanged
-        from fedtorch_tpu.async_plane import AsyncFederatedTrainer
-        trainer = AsyncFederatedTrainer(cfg, model, algorithm,
-                                        fed_data.train,
-                                        val_data=fed_data.val)
-    else:
-        trainer = FederatedTrainer(cfg, model, algorithm, fed_data.train,
-                                   val_data=fed_data.val)
-    server, clients = trainer.init_state(rng)
-    server, clients, best_prec1, resumed = maybe_resume(
-        cfg.checkpoint.resume, server, clients, cfg,
-        cfg.checkpoint.checkpoint_index)
-    if resumed:
-        logger.log("resumed from round "
-                   f"{int(jax.device_get(server.round))}")
+        if not cfg.federated.federated:
+            # local-SGD mode: flatten the per-worker shards back into one
+            # training set and IID-repartition across workers
+            import numpy as np
+            try:
+                splits_x = np.asarray(fed_data.train.x).reshape(
+                    (-1,) + fed_data.train.x.shape[2:])
+                splits_y = np.asarray(fed_data.train.y).reshape(-1)
+                trainer = build_local_sgd(cfg, model, splits_x, splits_y)
+                server, clients, history = trainer.fit(rng)
+                res = jax.device_get(evaluate(model, server.params,
+                                              fed_data.test_x,
+                                              fed_data.test_y))
+                logger.log_val(len(history), "test", float(res.loss),
+                               float(res.top1), float(res.top5))
+                tel.health_update("complete", round_idx=len(history))
+            finally:
+                tel.close()
+            return {"test_top1": float(res.top1), "rounds": len(history)}
 
-    save_rounds = tuple(
-        int(x) for x in cfg.checkpoint.save_some_models.split(","))
-    async_ckpt = None
-    if cfg.checkpoint.async_save:
-        from fedtorch_tpu.utils import AsyncCheckpointer
-        async_ckpt = AsyncCheckpointer()
-    saver = async_ckpt.save if async_ckpt is not None else save_checkpoint
-    last_saved_round = None
-    supervisor = None
-    run_round = trainer.run_round
-    if cfg.fault.supervisor:
-        from fedtorch_tpu.robustness import RoundSupervisor
-        supervisor = RoundSupervisor(trainer, checkpoint_dir=ckpt_dir,
-                                     logger=logger)
-        run_round = supervisor.run_round
-    # process lifecycle: signal-driven drain + stall watchdog
-    # (robustness/preemption.py, robustness/watchdog.py). The stop
-    # decision is SPMD-agreed via the per-round scalar fetch; the
-    # watchdog is host-only and off by default (watchdog_timeout_s=0).
-    from fedtorch_tpu.robustness import PreemptionHandler, StallWatchdog
-    preempt = PreemptionHandler(logger=logger)
-    preempt.install()
-    trainer.attach_stop_signal(lambda: preempt.stop_requested)
-    # NOTE for operators: the timeout must comfortably exceed the
-    # worst-case compile + round + eval + checkpoint time — the first
-    # round pays XLA compilation under the same clock.
-    watchdog = StallWatchdog(cfg.fault.watchdog_timeout_s, logger=logger)
-    watchdog.start()
+        algorithm = make_algorithm(cfg)
+        if cfg.federated.sync_mode == "async":
+            # the async commit plane (docs/robustness.md "Asynchronous
+            # federation"): run_round executes one COMMIT and server.round
+            # counts commit versions, so the loop below — checkpointing,
+            # eval cadence, preemption drain, supervisor — runs unchanged
+            from fedtorch_tpu.async_plane import AsyncFederatedTrainer
+            trainer = AsyncFederatedTrainer(cfg, model, algorithm,
+                                            fed_data.train,
+                                            val_data=fed_data.val)
+        else:
+            trainer = FederatedTrainer(cfg, model, algorithm, fed_data.train,
+                                       val_data=fed_data.val)
+        server, clients = trainer.init_state(rng)
+        server, clients, best_prec1, resumed = maybe_resume(
+            cfg.checkpoint.resume, server, clients, cfg,
+            cfg.checkpoint.checkpoint_index)
+        if resumed:
+            logger.log("resumed from round "
+                       f"{int(jax.device_get(server.round))}")
+
+        save_rounds = tuple(
+            int(x) for x in cfg.checkpoint.save_some_models.split(","))
+        async_ckpt = None
+        if cfg.checkpoint.async_save:
+            from fedtorch_tpu.utils import AsyncCheckpointer
+            async_ckpt = AsyncCheckpointer()
+        saver = async_ckpt.save if async_ckpt is not None else save_checkpoint
+        last_saved_round = None
+        supervisor = None
+        run_round = trainer.run_round
+        if cfg.fault.supervisor:
+            from fedtorch_tpu.robustness import RoundSupervisor
+            supervisor = RoundSupervisor(trainer, checkpoint_dir=ckpt_dir,
+                                         logger=logger)
+            run_round = supervisor.run_round
+        # process lifecycle: signal-driven drain + stall watchdog
+        # (robustness/preemption.py, robustness/watchdog.py). The stop
+        # decision is SPMD-agreed via the per-round scalar fetch; the
+        # watchdog is host-only and off by default (watchdog_timeout_s=0).
+        from fedtorch_tpu.robustness import PreemptionHandler, StallWatchdog
+        preempt = PreemptionHandler(logger=logger)
+        preempt.install()
+        trainer.attach_stop_signal(lambda: preempt.stop_requested)
+        # NOTE for operators: the timeout must comfortably exceed the
+        # worst-case compile + round + eval + checkpoint time — the first
+        # round pays XLA compilation under the same clock.
+        watchdog = StallWatchdog(cfg.fault.watchdog_timeout_s, logger=logger)
+        watchdog.start()
+        # still inside the guard: this fetch can raise too (device
+        # fault, poisoned resume state) and must not leak the active
+        # telemetry / a 'starting' intent for a dead run
+        start_round = int(jax.device_get(server.round))
+        tel.event("run.start", start_round=start_round, resumed=resumed,
+                  num_comms=cfg.federated.num_comms)
+    except BaseException:
+        tel.health_update("error")
+        tel.close()
+        raise
     results = {}
-    start_round = int(jax.device_get(server.round))
     loop_raised = False
     try:
         for r in range(start_round, cfg.federated.num_comms):
@@ -588,21 +644,28 @@ def run_experiment(cfg: ExperimentConfig,
             prev_params = jax.tree.map(jnp.copy, server.params) \
                 if cfg.checkpoint.track_model_aggregation else None
             timer.start("round")
-            server, clients, metrics = run_round(server, clients)
-            if supervisor is None:
-                # the supervisor's health check already blocked
-                jax.block_until_ready(server.params)
+            # the "round" span covers dispatch through completion of
+            # the jitted round/commit program — what the 90%-non-MXU
+            # attribution question is asked against
+            with tel.span("round", round=r):
+                server, clients, metrics = run_round(server, clients)
+                if supervisor is None:
+                    # the supervisor's health check already blocked
+                    jax.block_until_ready(server.params)
             round_time = timer.stop("round")
             # ONE batched device->host fetch for everything this loop
             # logs (round_host_scalars) — per-scalar float() here would
             # serialize a transfer per metric per round (lint FTL001).
             # A supervised healthy round already fetched the same dict
             # for its health check: reuse it, don't transfer twice.
+            fetch_t0 = time.perf_counter()
             if supervisor is not None and \
                     supervisor.last_scalars is not None:
                 sc = supervisor.last_scalars
             else:
-                sc = trainer.round_host_scalars(clients, metrics)
+                with tel.span("scalar_fetch", round=r):
+                    sc = trainer.round_host_scalars(clients, metrics)
+            fetch_s = time.perf_counter() - fetch_t0
             timer.add_comm(num_bytes=sc["comm_bytes"])
             # the scalar fetch blocked on the round's results: the
             # round genuinely completed — feed the stall watchdog
@@ -637,13 +700,15 @@ def run_experiment(cfg: ExperimentConfig,
                              comm_bytes=sc["comm_bytes"],
                              round_time=round_time)
 
+            eval_s = checkpoint_s = None
             if (r + 1) % cfg.train.eval_freq == 0:
                 timer.start("eval")
-                # one transfer for the whole EvalResult pytree
-                res = jax.device_get(evaluate(
-                    model, server.params, fed_data.test_x,
-                    fed_data.test_y))
-                timer.stop("eval")
+                with tel.span("eval", round=r):
+                    # one transfer for the whole EvalResult pytree
+                    res = jax.device_get(evaluate(
+                        model, server.params, fed_data.test_x,
+                        fed_data.test_y))
+                eval_s = timer.stop("eval")
                 top1 = float(res.top1)
                 is_best = top1 > best_prec1
                 best_prec1 = max(best_prec1, top1)
@@ -658,11 +723,13 @@ def run_experiment(cfg: ExperimentConfig,
                     logger.log("Round: {}. Per-class acc: {}".format(
                         r, [round(float(a), 4) for a in accs]))
                 timer.start("checkpoint")
-                saver(ckpt_dir, server, clients, cfg, best_prec1,
-                      is_best, save_all=cfg.checkpoint.save_all_models,
-                      save_some_rounds=save_rounds)
+                with tel.span("checkpoint", round=r):
+                    saver(ckpt_dir, server, clients, cfg, best_prec1,
+                          is_best,
+                          save_all=cfg.checkpoint.save_all_models,
+                          save_some_rounds=save_rounds)
                 last_saved_round = r
-                timer.stop("checkpoint")
+                checkpoint_s = timer.stop("checkpoint")
                 if cfg.federated.personal and fed_data.val is not None \
                         and cfg.effective_algorithm in (
                             "apfl", "perfedme", "perfedavg"):
@@ -673,6 +740,46 @@ def run_experiment(cfg: ExperimentConfig,
                                    summary["loss_mean"],
                                    summary["acc_mean"])
                 results["test_top1"] = top1
+
+            # one schema-versioned metrics row per round (async: per
+            # commit), populated from the already-fetched scalar dict
+            # plus host-only subsystem gauges — zero extra transfers
+            n_onl = max(sc["n_online"], 1.0)
+            row = {
+                "round": r, "round_s": round_time,
+                "loss": sc["loss_sum"] / n_onl,
+                "acc": sc["acc_sum"] / n_onl, "lr": sc["lr"],
+                "n_online": sc["n_online"],
+                "comm_bytes": sc["comm_bytes"],
+                "mean_epoch": sc["mean_epoch"], "fetch_s": fetch_s,
+                "dropped": sc["dropped"],
+                "stragglers": sc["stragglers"],
+                "rejected": sc["rejected"], "clipped": sc["clipped"],
+                "staleness": sc["staleness"],
+            }
+            if eval_s is not None:
+                row["eval_s"] = eval_s
+                # already host floats (the eval device_get above) —
+                # riding the row costs nothing extra
+                row["test_top1"] = top1
+                row["best_top1"] = best_prec1
+            if checkpoint_s is not None:
+                row["checkpoint_s"] = checkpoint_s
+            row.update(trainer.telemetry_gauges())
+            if async_ckpt is not None:
+                row.update(async_ckpt.stats())
+            if supervisor is not None:
+                row.update(sup_rollbacks=float(supervisor.stats.rollbacks),
+                           sup_retries=float(supervisor.stats.retries),
+                           sup_skipped=float(
+                               supervisor.stats.skipped_rounds))
+            tel.round_row(row)
+            # health: r+1 rounds complete — same convention as
+            # checkpoint.json's "round", so monitors can compare the
+            # live counter against the last durable one
+            tel.health_update("running", round_idx=r + 1,
+                              staleness=sc["staleness"])
+
             if round_callback is not None:
                 round_callback(r, trainer, server, clients, metrics)
             if sc.get("stop"):
@@ -686,15 +793,19 @@ def run_experiment(cfg: ExperimentConfig,
                 logger.log(f"preemption: stop requested "
                            f"({preempt.reason or 'peer host'}); "
                            f"draining after round {r}")
+                tel.event("preempt.drain", round=r,
+                          reason=preempt.reason or "peer host")
+                tel.health_update("drain", round_idx=r + 1)
                 if last_saved_round != r:
                     # skip when this round's eval branch already wrote
                     # the same state — the snapshot is a collective on
                     # pods and a preemption deadline is ticking
                     timer.start("checkpoint")
-                    saver(ckpt_dir, server, clients, cfg, best_prec1,
-                          False,
-                          save_all=cfg.checkpoint.save_all_models,
-                          save_some_rounds=save_rounds)
+                    with tel.span("checkpoint", round=r, drain=True):
+                        saver(ckpt_dir, server, clients, cfg,
+                              best_prec1, False,
+                              save_all=cfg.checkpoint.save_all_models,
+                              save_some_rounds=save_rounds)
                     timer.stop("checkpoint")
                 results["preempted"] = True
                 results["preempted_at_round"] = r
@@ -713,24 +824,50 @@ def run_experiment(cfg: ExperimentConfig,
         # leave a worker thread blocked on the feed queue, and a
         # library caller resuming this trainer later re-syncs cleanly
         trainer.invalidate_stream()
-        if async_ckpt is not None:
-            # flush pending writes even when the loop raised — the
-            # checkpoint the user would resume from must hit disk. A
-            # flush failure must not MASK the loop's own exception, but
-            # must still raise when the loop succeeded (sys.exc_info()
-            # can't distinguish the two: it also reports exceptions
-            # being handled further up the call stack).
-            timer.start("checkpoint")
-            try:
-                async_ckpt.close()
-            except Exception as e:
-                if loop_raised:
-                    logger.log("WARNING: async checkpoint flush failed "
-                               f"while handling another error: {e}")
-                else:
-                    raise
-            finally:
-                timer.stop("checkpoint")
+        flush_raised = False
+        try:
+            if async_ckpt is not None:
+                # flush pending writes even when the loop raised — the
+                # checkpoint the user would resume from must hit disk.
+                # A flush failure must not MASK the loop's own
+                # exception, but must still raise when the loop
+                # succeeded (sys.exc_info() can't distinguish the two:
+                # it also reports exceptions being handled further up
+                # the call stack).
+                timer.start("checkpoint")
+                try:
+                    async_ckpt.close()
+                except Exception as e:
+                    flush_raised = True
+                    if loop_raised:
+                        logger.log("WARNING: async checkpoint flush "
+                                   "failed while handling another "
+                                   f"error: {e}")
+                    else:
+                        raise
+                finally:
+                    timer.stop("checkpoint")
+        finally:
+            # final telemetry: the staleness histogram (async plane),
+            # the run-end event, the exit intent, and the trace export
+            # — best-effort bookkeeping that must never mask the
+            # loop's outcome (the emitters and Telemetry.close never
+            # raise)
+            hist = trainer.staleness_histogram()
+            if hist:
+                tel.event("async.staleness_hist",
+                          hist={str(k): v
+                                for k, v in sorted(hist.items())})
+            tel.event("run.end",
+                      preempted=bool(results.get("preempted")),
+                      raised=loop_raised or flush_raised)
+            if loop_raised or flush_raised:
+                tel.health_update("error")
+            elif results.get("preempted"):
+                tel.health_update("preempted")
+            else:
+                tel.health_update("complete")
+            tel.close()
     results["best_top1"] = best_prec1
     if supervisor is not None:
         st = supervisor.stats
@@ -764,6 +901,12 @@ def main(argv=None):
         # initializes jax
         from fedtorch_tpu.lint.cli import main as lint_main
         return lint_main(argv[1:])
+    if argv and argv[0] == "report":
+        # `fedtorch-tpu report <run_dir>` — summarize a run dir's
+        # telemetry (docs/observability.md); stdlib-only, never
+        # initializes jax
+        from fedtorch_tpu.tools.report import main as report_main
+        return report_main(argv[1:])
     if argv and argv[0] == "supervise":
         # `fedtorch-tpu supervise [opts] -- <training command>` — the
         # per-host auto-restart harness (robustness/harness.py):

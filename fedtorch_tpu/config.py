@@ -378,6 +378,24 @@ class FaultConfig:
 
 
 @dataclass(frozen=True)
+class TelemetryConfig:
+    """Run-telemetry knobs (``fedtorch_tpu.telemetry``,
+    docs/observability.md). The subsystem is host-only: no level
+    touches a traced program (HLO byte-identical on/off, pinned in
+    tests/test_telemetry.py) and every level keeps the per-round
+    device-sync count at the loop's one batched fetch."""
+    # 'off' = no files, every hook a no-op; 'default' = metrics.jsonl
+    # + events.jsonl + health.json + host spans (trace.json exported at
+    # run end; measured <= 1% round overhead, TELEMETRY_AB.json);
+    # 'debug' additionally re-exports trace.json every 25 rounds so a
+    # live Perfetto session can follow a long run.
+    level: str = "default"
+    # span-buffer bound: past this, new spans are counted as dropped
+    # instead of growing host memory on month-long runs
+    max_span_events: int = 200_000
+
+
+@dataclass(frozen=True)
 class MeshConfig:
     """Device mesh layout — replaces the reference's process topology
     (``FCGraph``, utils/topology.py:57-114) with a JAX mesh.
@@ -444,6 +462,7 @@ class ExperimentConfig:
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     fault: FaultConfig = field(default_factory=FaultConfig)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     experiment: Optional[str] = None
 
     def finalize(self) -> "ExperimentConfig":
@@ -581,6 +600,14 @@ class ExperimentConfig:
             raise ValueError(
                 "checkpoint.keep_last_n must be >= 0 (0 = unlimited), "
                 f"got {self.checkpoint.keep_last_n}")
+        if self.telemetry.level not in ("off", "default", "debug"):
+            raise ValueError(
+                "telemetry.level must be 'off', 'default' or 'debug', "
+                f"got {self.telemetry.level!r}")
+        if self.telemetry.max_span_events < 1:
+            raise ValueError(
+                "telemetry.max_span_events must be >= 1, got "
+                f"{self.telemetry.max_span_events}")
 
         return dataclasses.replace(
             self, data=data, federated=fed, train=train, optim=optim)

@@ -32,12 +32,14 @@ The trainer-side consumer is ``FederatedTrainer.round_stream_fn``
 from __future__ import annotations
 
 import contextlib
+import time
 from typing import Callable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from fedtorch_tpu import telemetry
 from fedtorch_tpu.data.batching import ClientData, round_row_plan
 from fedtorch_tpu.native.host_pipeline import HostPrefetcher, gather_rows
 
@@ -247,25 +249,43 @@ class StreamFeedProducer:
             self._schedule = None
         self._expected = self.start_round
         self.rounds_produced = 0
+        # host-side gauges (fedtorch_tpu.telemetry; all seconds except
+        # the counts): what used to die in thread-local variables
+        self.gather_s = 0.0   # producer: schedule replay + row pack
+        self.h2d_s = 0.0      # producer: device_put DISPATCH wall
+        self.wait_s = 0.0     # consumer: blocked on the feed queue
         self._prefetcher = HostPrefetcher(self._produce, depth=depth,
                                           name="stream-feed-producer")
 
     def _produce(self, step: int):
-        if self._plan_fn is not None:
-            label, idx, rows, extras = self._plan_fn(step)
-        else:
-            label = self.start_round + step
-            idx, rows = self._schedule(label)
-            extras = None
-        feed = self.store.pack(idx, rows, self.batch_size)
+        t0 = time.perf_counter()
+        with telemetry.span("stream.gather", step=step):
+            if self._plan_fn is not None:
+                label, idx, rows, extras = self._plan_fn(step)
+            else:
+                label = self.start_round + step
+                idx, rows = self._schedule(label)
+                extras = None
+            feed = self.store.pack(idx, rows, self.batch_size)
+        t1 = time.perf_counter()
         # device_put dispatches the H2D copy and returns immediately —
-        # the transfer rides behind the in-flight round's compute
-        placed = self._place(feed if extras is None else (feed, extras))
+        # the transfer rides behind the in-flight round's compute (so
+        # this span is DISPATCH cost; the transfer itself shows up on
+        # the device timeline of a profiler capture)
+        with telemetry.span("stream.h2d_dispatch", round=label):
+            placed = self._place(feed if extras is None else
+                                 (feed, extras))
+        self.gather_s += t1 - t0
+        self.h2d_s += time.perf_counter() - t1
         self.rounds_produced += 1
         return label, placed
 
     def next_feed(self) -> RoundFeed:
-        round_idx, feed = self._prefetcher.next(timeout=self._timeout_s)
+        t0 = time.perf_counter()
+        with telemetry.span("stream.wait", round=self._expected):
+            round_idx, feed = self._prefetcher.next(
+                timeout=self._timeout_s)
+        self.wait_s += time.perf_counter() - t0
         if round_idx != self._expected:
             raise RuntimeError(
                 f"stream feed for round {round_idx} but round "
@@ -274,6 +294,21 @@ class StreamFeedProducer:
                 "invalidate_stream?)")
         self._expected += 1
         return feed
+
+    def stats(self) -> dict:
+        """Host gauges for the telemetry round row: prefetch depth at
+        call time, cumulative producer gather/H2D-dispatch wall, and
+        cumulative consumer wait. A steadily positive ``wait_s`` delta
+        with depth 0 means the producer is the round clock — the
+        input-stall signal tf.data's instrumentation exists to surface
+        (Murray et al. 2021)."""
+        return {
+            "stream_depth": float(self._prefetcher.depth()),
+            "stream_wait_s": self.wait_s,
+            "stream_gather_s": self.gather_s,
+            "stream_h2d_s": self.h2d_s,
+            "stream_produced": float(self.rounds_produced),
+        }
 
     def close(self) -> bool:
         """Stop the producer; True when the thread verifiably exited

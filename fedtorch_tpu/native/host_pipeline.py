@@ -234,6 +234,13 @@ class HostPrefetcher:
             raise item
         return item
 
+    def depth(self) -> int:
+        """Items currently buffered (approximate by nature — the worker
+        appends concurrently); the stream plane's prefetch-depth gauge
+        (fedtorch_tpu.telemetry): depth 0 at fetch time means the
+        consumer is about to block on the producer."""
+        return self._q.qsize()
+
     def close(self, join_timeout: float = 5.0) -> bool:
         """Stop the producer and drop queued items. Returns True when
         the worker thread actually exited within the bounded join —

@@ -938,6 +938,32 @@ class FederatedTrainer:
         return {k: float(v) for k, v in jax.device_get(
             self.round_scalars_dev(clients, metrics)).items()}
 
+    # -- telemetry gauges (fedtorch_tpu.telemetry) ------------------------
+    def stream_stats(self) -> Optional[dict]:
+        """Stream-plane producer gauges (prefetch depth, producer
+        gather/H2D wall, consumer wait) — None on the device plane or
+        before the first streamed round. Host counters only: reading
+        them costs no device sync."""
+        s = getattr(self, "_stream", None)
+        return s.stats() if s is not None else None
+
+    def telemetry_gauges(self) -> dict:
+        """Host-side subsystem gauges riding the telemetry round row
+        (docs/observability.md "Metric catalog") — values that used to
+        die in process memory. Strictly host counters: the row stays
+        zero-extra-device-syncs by construction. Subclasses extend
+        (the async plane adds its scheduler counters)."""
+        out = {}
+        ss = self.stream_stats()
+        if ss is not None:
+            out.update(ss)
+        return out
+
+    def staleness_histogram(self) -> Optional[dict]:
+        """{commits-stale: count} over committed updates — async
+        commit plane only (None here)."""
+        return None
+
     # -- streaming feed plumbing (data_plane='stream') --------------------
     def _next_stream_feed(self, server) -> RoundFeed:
         """Pop the next round's host-packed feed, (re)starting the

@@ -40,6 +40,24 @@ from typing import Callable, Optional, Sequence
 from fedtorch_tpu.robustness.preemption import RESTART_EXIT_CODE
 
 
+def read_exit_intent(ckpt_dir: Optional[str]) -> Optional[str]:
+    """The child's machine-readable exit intent from the run dir's
+    ``health.json`` (fedtorch_tpu.telemetry, docs/observability.md):
+    'preempted' = clean SIGTERM drain, 'stalled' = watchdog fired on a
+    wedged pod, 'error' = the round loop raised. None when the file is
+    missing (telemetry off / pre-telemetry run) or unreadable — the
+    harness logs the intent but never gates on it, so it keeps
+    supervising heterogeneous jobs."""
+    if ckpt_dir is None:
+        return None
+    try:
+        from fedtorch_tpu.telemetry import read_health
+        doc = read_health(ckpt_dir)
+        return None if doc is None else str(doc.get("intent"))
+    except Exception:  # schema skew must not kill the harness
+        return None
+
+
 def read_checkpoint_round(ckpt_dir: Optional[str]) -> Optional[int]:
     """The round recorded in ``<ckpt_dir>/checkpoint.json`` — the
     harness's only probe into the job's progress. None when the file
@@ -151,6 +169,9 @@ class ElasticRunner:
                 return rc
 
             round_after = read_checkpoint_round(self.ckpt_dir)
+            intent = read_exit_intent(self.ckpt_dir)
+            if intent is not None:
+                self._log(f"child health intent: {intent}")
             advanced = (round_after is not None
                         and (round_before is None
                              or round_after > round_before))

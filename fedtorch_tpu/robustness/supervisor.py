@@ -42,6 +42,7 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 
+from fedtorch_tpu import telemetry
 from fedtorch_tpu.config import FaultConfig
 from fedtorch_tpu.core.state import RoundMetrics
 from fedtorch_tpu.utils.diagnostics import model_norms
@@ -218,6 +219,8 @@ class RoundSupervisor:
                 why = f"round program raised: {e!r}"
 
             self.stats.rollbacks += 1
+            telemetry.event("supervisor.rollback", round=round_idx,
+                            attempt=attempt + 1, why=why)
             server, clients = self._restore(snapshot)
             # the streaming data plane replays (rng, round) host-side;
             # a rollback (and the reseed below) rewrites both out from
@@ -241,6 +244,8 @@ class RoundSupervisor:
 
         # degrade: keep the healthy rolled-back state, skip the round
         self.stats.skipped_rounds += 1
+        telemetry.event("supervisor.round_skipped", round=round_idx,
+                        attempts=flt.max_retries + 1)
         server = server._replace(round=server.round + 1)
         self._log(f"supervisor: round {round_idx} skipped after "
                   f"{flt.max_retries + 1} attempts; state rolled back")

@@ -129,6 +129,23 @@ class StallWatchdog:
             "dead peer blocking a DCN collective. Dumping thread "
             f"stacks and exiting {self.exit_code} (restartable).")
         try:
+            # flip the machine-readable exit intent FIRST: an external
+            # monitor polling health.json learns "stalled, exiting 75"
+            # even if the diagnostics below wedge on a sick filesystem
+            from fedtorch_tpu import telemetry
+            tel = telemetry.get_active()
+            if tel is not None:
+                tel.event("watchdog.fired", elapsed_s=elapsed,
+                          last_round=self.last_round)
+                # no round_idx: the health file already holds the
+                # loop's rounds-completed counter, and writing the
+                # watchdog's (differently-based) heartbeat round would
+                # count as progress — a wedged host must NOT report
+                # since_progress_s ~ 0 in its own stall document
+                tel.health_update("stalled", exit_code=self.exit_code)
+        except Exception as e:  # telemetry must never block the exit
+            self._log(f"StallWatchdog: health update failed: {e!r}")
+        try:
             from fedtorch_tpu.utils.diagnostics import runtime_snapshot
             self._log(f"StallWatchdog: runtime: {runtime_snapshot()}")
         except Exception as e:  # diagnostics must never block the exit

@@ -1,0 +1,97 @@
+"""Append-only JSONL writers for ``metrics.jsonl`` / ``events.jsonl``.
+
+One line per record, first line a schema header. Rows are buffered in
+memory and flushed on a time budget (``flush_interval_s``, default 1s)
+or every ``flush_rows`` records, whichever comes first — a per-row
+flush would put an fsync-adjacent syscall on the round clock (measured
+~100us/row on hardened filesystems, the second-largest term of the
+telemetry A/B), while a 1s budget bounds crash loss to one second of
+rows (``iter_jsonl`` skips a torn tail) and keeps ``tail -f`` usable.
+Events flush immediately (rare, and each one matters). Values must
+already be host-side Python scalars — the writers never touch device
+values, which is what keeps the emission path FTL001-clean and the
+per-round device-sync count at exactly the one batched fetch the loop
+already paid.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+
+class JsonlWriter:
+    """Buffered line-per-record appender with a schema header.
+
+    Failure-tolerant like the health file: IO errors are counted and
+    the writer goes inert instead of killing training."""
+
+    def __init__(self, path: str, schema: str,
+                 run_meta: Optional[Dict] = None,
+                 flush_interval_s: float = 1.0, flush_rows: int = 200):
+        self.path = path
+        self.schema = schema
+        self.rows = 0
+        self.write_errors = 0
+        self.flush_interval_s = float(flush_interval_s)
+        self.flush_rows = int(flush_rows)
+        self._buf: List[str] = []
+        self._last_flush = time.monotonic()
+        self._f = None
+        self._header = {"schema": schema,
+                        "created_unix": time.time(),
+                        **({"run": run_meta} if run_meta else {})}
+
+    def _ensure_open(self):
+        if self._f is not None or self.write_errors:
+            return self._f
+        try:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            self._f = open(self.path, "a")
+            if self._f.tell() == 0:
+                self._f.write(json.dumps(self._header) + "\n")
+                self._f.flush()
+        except OSError:
+            self.write_errors += 1
+            self._f = None
+        return self._f
+
+    def write(self, row: Dict, flush: bool = False) -> None:
+        try:
+            self._buf.append(json.dumps(row) + "\n")
+        except (TypeError, ValueError):
+            self.write_errors += 1
+            return
+        self.rows += 1
+        now = time.monotonic()
+        if (flush or len(self._buf) >= self.flush_rows
+                or now - self._last_flush >= self.flush_interval_s):
+            self.flush()
+
+    def flush(self) -> None:
+        self._last_flush = time.monotonic()
+        if not self._buf:
+            return
+        f = self._ensure_open()
+        if f is None:
+            self._buf.clear()  # inert writer: don't grow forever
+            return
+        try:
+            # one write call for the batch: concurrent readers (and a
+            # crash) see whole lines or nothing
+            f.write("".join(self._buf))
+            f.flush()
+            self._buf.clear()
+        except OSError:
+            self.write_errors += 1
+            self._buf.clear()
+
+    def close(self) -> None:
+        self.flush()
+        if self._f is not None:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+            self._f = None

@@ -1,0 +1,148 @@
+"""Telemetry record schemas (docs/observability.md "Metric catalog").
+
+Everything the run emits machine-readably is versioned here: the
+``metrics.jsonl`` per-round row, the ``events.jsonl`` event record, and
+the ``health.json`` liveness document. Consumers (the ``fedtorch-tpu
+report`` tool, external monitors, tests) key on ``SCHEMA`` /
+``HEALTH_SCHEMA`` strings instead of sniffing shapes, so a future
+breaking change bumps the version and old parsers fail loudly.
+
+Stdlib-only on purpose: the report tool and external monitors must be
+able to parse a run dir without initializing JAX.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, Iterator, Optional
+
+# bump ONLY on breaking changes (renamed/retyped required fields);
+# adding optional fields is backward-compatible and needs no bump
+METRICS_SCHEMA = "fedtorch_tpu.metrics/v1"
+EVENTS_SCHEMA = "fedtorch_tpu.events/v1"
+HEALTH_SCHEMA = "fedtorch_tpu.health/v1"
+
+# -- the per-round metrics row ------------------------------------------
+# Required fields every row carries. All values are host-side Python
+# scalars: the row is populated exclusively from the round loop's ONE
+# batched scalar fetch (FederatedTrainer.round_host_scalars) plus
+# host-only counters — emitting a row costs zero device syncs.
+METRICS_REQUIRED = {
+    "round": int,        # round index (async: commit version)
+    "round_s": float,    # wall-clock of the jitted round/commit call
+    "loss": float,       # mean online train loss
+    "acc": float,        # mean online train top-1
+    "lr": float,         # schedule LR at the round's mean epoch
+    "n_online": float,   # online clients this round
+    "comm_bytes": float,  # uplink payload volume
+}
+
+# Optional gauge groups (absent when the subsystem is off). Names are
+# the catalog rendered in docs/observability.md.
+METRICS_OPTIONAL = {
+    # robustness counters (chaos/guards; 0-valued when enabled but calm)
+    "dropped": "chaos-crashed clients masked out of aggregation",
+    "stragglers": "step-budget cuts (async: delayed dispatches)",
+    "rejected": "guard-rejected updates",
+    "clipped": "guard-norm-clipped updates",
+    "staleness": "mean snapshot staleness this commit (async plane)",
+    "mean_epoch": "mean training epoch over real clients",
+    # per-round host phase wall-clock (seconds)
+    "fetch_s": "batched scalar-fetch wall (blocks on the round)",
+    "eval_s": "server eval wall (eval rounds only)",
+    "checkpoint_s": "checkpoint snapshot+dispatch wall (eval rounds)",
+    # eval results (eval rounds only; host floats from the eval fetch)
+    "test_top1": "server-model test top-1 this eval",
+    "best_top1": "best test top-1 so far",
+    # stream plane (trainer.stream_stats)
+    "stream_depth": "prefetched feeds ready at fetch time",
+    "stream_wait_s": "consumer wall blocked on the feed queue (total)",
+    "stream_gather_s": "producer schedule+pack wall (total)",
+    "stream_h2d_s": "producer device_put dispatch wall (total)",
+    "stream_produced": "feeds produced since (re)start",
+    # async commit plane (trainer.schedule_stats + staleness histogram)
+    "async_dispatches": "client dispatches simulated so far",
+    "async_stragglers": "tail-delayed dispatches so far",
+    "async_ring_clamped": "arrivals older than the snapshot ring",
+    "async_buffer": "buffer size m (updates folded per commit)",
+    "async_commit_rate": "commits per virtual time unit so far",
+    # checkpoint IO (AsyncCheckpointer.stats)
+    "ckpt_queue_depth": "writes queued behind the worker",
+    "ckpt_writes": "checkpoints durably written so far",
+    "ckpt_last_write_s": "serialization+disk wall of the last write",
+    "ckpt_total_write_s": "cumulative write wall over the run",
+    # supervisor (host counters)
+    "sup_rollbacks": "supervisor rollbacks so far",
+    "sup_retries": "supervisor retries so far",
+    "sup_skipped": "supervisor skipped rounds so far",
+}
+
+HEALTH_INTENTS = (
+    "starting",   # process up, loop not yet entered
+    "running",    # making round progress
+    "drain",      # stop agreed; writing the final checkpoint
+    "preempted",  # drained and exiting restartable (75)
+    "stalled",    # watchdog fired; exiting restartable (75)
+    "complete",   # ran to num_comms
+    "error",      # round loop raised
+)
+
+
+def validate_metrics_row(row: Dict) -> None:
+    """Raise ``ValueError`` when ``row`` violates the v1 contract —
+    the schema half of the round-trip test."""
+    for key, typ in METRICS_REQUIRED.items():
+        if key not in row:
+            raise ValueError(f"metrics row missing required {key!r}")
+        v = row[key]
+        if typ is float and isinstance(v, (int, float)) \
+                and not isinstance(v, bool):
+            continue
+        if typ is int and isinstance(v, int) and not isinstance(v, bool):
+            continue
+        raise ValueError(
+            f"metrics row field {key!r} must be {typ.__name__}, got "
+            f"{type(v).__name__} ({v!r})")
+    unknown = [k for k in row
+               if k not in METRICS_REQUIRED and k not in METRICS_OPTIONAL]
+    if unknown:
+        raise ValueError(
+            f"metrics row carries uncataloged fields {unknown!r} — add "
+            "them to telemetry.schema.METRICS_OPTIONAL (the catalog is "
+            "the contract docs/observability.md renders)")
+
+
+def validate_health(doc: Dict) -> None:
+    if doc.get("schema") != HEALTH_SCHEMA:
+        raise ValueError(
+            f"health schema {doc.get('schema')!r} != {HEALTH_SCHEMA!r}")
+    for key in ("pid", "host", "round", "intent", "updated_unix",
+                "progress_monotonic"):
+        if key not in doc:
+            raise ValueError(f"health.json missing required {key!r}")
+    if doc["intent"] not in HEALTH_INTENTS:
+        raise ValueError(f"unknown health intent {doc['intent']!r} "
+                         f"(expected one of {HEALTH_INTENTS})")
+
+
+def iter_jsonl(path: str) -> Iterator[Dict]:
+    """Yield one dict per line; the header line (``{"schema": ...}``)
+    is included — callers filter on the ``"schema"`` key. A trailing
+    partial line (crash mid-append) is skipped, not fatal: every
+    COMPLETE line was written atomically enough (single ``write`` of a
+    line under append mode) to parse."""
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                yield json.loads(line)
+            except json.JSONDecodeError:
+                # only legal for the file's last, torn line
+                continue
+
+
+def read_header(path: str) -> Optional[Dict]:
+    for rec in iter_jsonl(path):
+        return rec if "schema" in rec else None
+    return None

@@ -5,3 +5,6 @@ from fedtorch_tpu.tools.plots import (  # noqa: F401
 from fedtorch_tpu.tools.records import (  # noqa: F401
     load_record_file, parse_records, smoothing,
 )
+from fedtorch_tpu.tools.report import (  # noqa: F401
+    load_run, render, summarize,
+)

@@ -1,0 +1,221 @@
+"""``fedtorch-tpu report``: render a run dir into a summary table.
+
+Reads the telemetry files a run emits (``metrics.jsonl`` /
+``events.jsonl`` / ``health.json``, fedtorch_tpu.telemetry) and prints
+the questions an operator actually asks: how fast were rounds, where
+did the wall-time go (phase breakdown — the 90%-non-MXU attribution at
+run granularity), how much was communicated, did accuracy move, what
+did the robustness machinery do, and how did the process exit.
+
+Supersedes regex-parsing ``record0``: the legacy text lines are still
+written (reference parity — ``tools/records.py`` keeps parsing them)
+and remain the FALLBACK here for pre-telemetry run dirs, so old
+experiment trees stay renderable.
+
+Stdlib-only (no jax): a monitor box can summarize a mounted run dir.
+
+Usage::
+
+    fedtorch-tpu report <run_dir>
+    python -m fedtorch_tpu.tools.report <run_dir>
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Dict, List, Optional
+
+from fedtorch_tpu.telemetry import iter_jsonl, read_health
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024.0 or unit == "TiB":
+            return f"{n:.1f} {unit}"
+        n /= 1024.0
+    return f"{n:.1f} TiB"
+
+
+def _fmt_s(s: Optional[float]) -> str:
+    return "-" if s is None else (f"{s * 1e3:.2f} ms" if s < 1.0
+                                  else f"{s:.2f} s")
+
+
+def load_run(run_dir: str) -> Dict:
+    """Structured view of one run dir: telemetry rows when present,
+    the ``record0`` regex fallback otherwise."""
+    out: Dict = {"run_dir": run_dir, "source": None, "meta": {},
+                 "rows": [], "events": [], "health": None}
+    mpath = os.path.join(run_dir, "metrics.jsonl")
+    if os.path.exists(mpath):
+        out["source"] = "telemetry"
+        for rec in iter_jsonl(mpath):
+            if "schema" in rec:
+                out["meta"] = rec.get("run", {}) or {}
+            else:
+                out["rows"].append(rec)
+        epath = os.path.join(run_dir, "events.jsonl")
+        if os.path.exists(epath):
+            out["events"] = [r for r in iter_jsonl(epath)
+                             if "schema" not in r]
+        out["health"] = read_health(run_dir)
+        return out
+    # legacy fallback: regex-parse the record file (reference parity)
+    rpath = os.path.join(run_dir, "record0")
+    if os.path.exists(rpath):
+        from fedtorch_tpu.tools.records import load_record_file
+        rec = load_record_file(rpath)
+        out["source"] = "record0"
+        for t in rec["train"]:
+            out["rows"].append({
+                "round": int(t["round"]), "round_s": t["computing"],
+                "loss": t["loss"], "acc": t["top1"], "lr": t["lr"],
+                "n_online": 0.0, "comm_bytes": t["comm_bytes"],
+            })
+        vals = [v for v in rec["val"] if v["mode"] == "test"]
+        if vals:
+            out["rows"] and out["rows"][-1].setdefault(
+                "test_top1", vals[-1]["top1"])
+            out["meta"]["final_test_top1"] = vals[-1]["top1"]
+        return out
+    raise FileNotFoundError(
+        f"{run_dir}: neither metrics.jsonl nor record0 found — not a "
+        "run dir (or telemetry was off and logging disabled)")
+
+
+def _phase_table(rows: List[Dict]) -> List[tuple]:
+    """(phase, total_s, share) over the phases the rows carry. The
+    'round' phase is the jitted program's dispatch-to-completion wall;
+    fetch/eval/checkpoint are the host phases around it."""
+    phases = [("round", "round_s"), ("scalar_fetch", "fetch_s"),
+              ("eval", "eval_s"), ("checkpoint", "checkpoint_s")]
+    totals = []
+    for name, key in phases:
+        vals = [r[key] for r in rows if key in r]
+        if vals:
+            totals.append((name, sum(vals), len(vals)))
+    whole = sum(t for _, t, _ in totals) or 1.0
+    return [(n, t, t / whole, c) for n, t, c in totals]
+
+
+def summarize(run_dir: str) -> Dict:
+    """The machine-readable summary the text report renders (tests
+    assert on this dict, not on formatting)."""
+    run = load_run(run_dir)
+    rows = run["rows"]
+    if not rows:
+        return {"run_dir": run_dir, "source": run["source"],
+                "rounds": 0, "meta": run["meta"],
+                "health": run["health"]}
+    round_s = [r["round_s"] for r in rows]
+    total = sum(round_s)
+    # steady-state rate excludes the first round (it pays compilation);
+    # with one round there is no steady state to report
+    steady = round_s[1:] or round_s
+    evals = [r for r in rows if "test_top1" in r]
+    s = {
+        "run_dir": run_dir,
+        "source": run["source"],
+        "meta": run["meta"],
+        "rounds": len(rows),
+        "first_round": rows[0]["round"],
+        "last_round": rows[-1]["round"],
+        "round_s_total": total,
+        "round_s_mean_steady": sum(steady) / len(steady),
+        "rounds_per_s_steady": len(steady) / sum(steady)
+        if sum(steady) > 0 else float("inf"),
+        "compile_round_s": round_s[0],
+        "comm_bytes_total": sum(r["comm_bytes"] for r in rows),
+        "comm_bytes_per_round": sum(r["comm_bytes"] for r in rows)
+        / len(rows),
+        "final_loss": rows[-1]["loss"],
+        "final_acc": rows[-1]["acc"],
+        "phases": _phase_table(rows),
+        "health": run["health"],
+        "events": {},
+        "last_gauges": {},
+    }
+    if evals:
+        s["final_test_top1"] = evals[-1]["test_top1"]
+        s["best_test_top1"] = max(r["test_top1"] for r in evals)
+    for ev in run["events"]:
+        name = ev.get("event", "?")
+        s["events"][name] = s["events"].get(name, 0) + 1
+    # robustness totals (per-round counters summed) + last-row gauges
+    for key in ("dropped", "stragglers", "rejected", "clipped"):
+        vals = [r[key] for r in rows if key in r]
+        if vals and sum(vals):
+            s["events"][f"total_{key}"] = sum(vals)
+    last = rows[-1]
+    for key in sorted(last):
+        if key.startswith(("stream_", "async_", "ckpt_", "sup_")):
+            s["last_gauges"][key] = last[key]
+    return s
+
+
+def render(run_dir: str) -> str:
+    s = summarize(run_dir)
+    lines = [f"run: {s['run_dir']}  (source: {s['source']})"]
+    meta = s.get("meta") or {}
+    if meta:
+        kv = " ".join(f"{k}={v}" for k, v in sorted(meta.items())
+                      if v is not None and k != "final_test_top1")
+        if kv:
+            lines.append(f"config: {kv}")
+    if not s["rounds"]:
+        lines.append("no completed rounds recorded")
+        return "\n".join(lines)
+    lines.append(
+        f"rounds: {s['rounds']} "
+        f"(r{s['first_round']}..r{s['last_round']})  "
+        f"steady-state: {_fmt_s(s['round_s_mean_steady'])}/round "
+        f"({s['rounds_per_s_steady']:.2f} rounds/s)  "
+        f"first (compile): {_fmt_s(s['compile_round_s'])}")
+    lines.append(
+        f"comm: {_fmt_bytes(s['comm_bytes_total'])} total, "
+        f"{_fmt_bytes(s['comm_bytes_per_round'])}/round")
+    acc = (f"final test top1: {s['final_test_top1']:.4f} "
+           f"(best {s['best_test_top1']:.4f})  "
+           if "final_test_top1" in s else "")
+    lines.append(f"{acc}final train loss: {s['final_loss']:.4f}  "
+                 f"acc: {s['final_acc']:.4f}")
+    if s["phases"]:
+        lines.append("phase breakdown (host wall, summed over rounds):")
+        for name, t, share, count in s["phases"]:
+            lines.append(f"  {name:<13} {_fmt_s(t):>10}  "
+                         f"{share * 100:5.1f}%  ({count} rounds)")
+    if s["last_gauges"]:
+        lines.append("subsystem gauges (last round):")
+        for k, v in s["last_gauges"].items():
+            lines.append(f"  {k:<20} {v:g}")
+    if s["events"]:
+        ev = " ".join(f"{k}={v}" for k, v in sorted(s["events"].items()))
+        lines.append(f"events: {ev}")
+    h = s.get("health")
+    if h:
+        lines.append(
+            f"health: intent={h['intent']} round={h['round']} "
+            f"pid={h['pid']} since_progress="
+            f"{_fmt_s(h.get('since_progress_s'))}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="fedtorch-tpu report",
+        description="Summarize a run dir's telemetry "
+                    "(docs/observability.md)")
+    p.add_argument("run_dir", help="directory holding metrics.jsonl "
+                                   "(or a legacy record0)")
+    args = p.parse_args(argv)
+    try:
+        print(render(args.run_dir))
+    except FileNotFoundError as e:
+        print(f"report: {e}", file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -31,6 +31,7 @@ from typing import Optional, Tuple
 import jax
 from flax import serialization
 
+from fedtorch_tpu import telemetry
 from fedtorch_tpu.config import ExperimentConfig
 
 
@@ -273,14 +274,16 @@ def save_checkpoint(directory: str, server, clients,
     variant. Every process participates in the snapshot (it is a
     collective on multi-host); only process 0 touches the disk."""
     path = os.path.join(directory, "checkpoint.ckpt")
-    host_state = _snapshot(server, clients, cfg)
+    with telemetry.span("checkpoint.snapshot"):
+        host_state = _snapshot(server, clients, cfg)
     if not _is_writer_process():
         return path
     round_idx = int(server.round)
-    return _write_checkpoint(
-        directory, host_state,
-        _meta_for(cfg, round_idx, best_prec1), is_best, round_idx,
-        save_all, save_some_rounds, cfg.checkpoint.keep_last_n)
+    with telemetry.span("checkpoint.write", round=round_idx):
+        return _write_checkpoint(
+            directory, host_state,
+            _meta_for(cfg, round_idx, best_prec1), is_best, round_idx,
+            save_all, save_some_rounds, cfg.checkpoint.keep_last_n)
 
 
 class AsyncCheckpointer:
@@ -309,7 +312,13 @@ class AsyncCheckpointer:
         self._q: "queue.Queue" = queue.Queue(maxsize=1)
         self._errors: list = []
         self._closed = False
-        self._thread = threading.Thread(target=self._worker, daemon=True)
+        # write-latency/queue gauges for the telemetry round row
+        # (docs/observability.md): host counters, read lock-free
+        self.writes = 0
+        self.last_write_s = 0.0
+        self.total_write_s = 0.0
+        self._thread = threading.Thread(target=self._worker, daemon=True,
+                                        name="async-checkpointer")
         self._thread.start()
         atexit.register(self._atexit_close)
 
@@ -319,12 +328,29 @@ class AsyncCheckpointer:
             if job is None:
                 self._q.task_done()
                 return
+            t0 = time.perf_counter()
             try:
-                _write_checkpoint(*job)
+                # job[4] is round_idx (the _write_checkpoint signature)
+                with telemetry.span("checkpoint.write", round=job[4]):
+                    _write_checkpoint(*job)
+                self.writes += 1
             except Exception as e:  # surfaced on the next save()/wait()
                 self._errors.append(e)
             finally:
+                self.last_write_s = time.perf_counter() - t0
+                self.total_write_s += self.last_write_s
                 self._q.task_done()
+
+    def stats(self) -> dict:
+        """Telemetry gauges: durable writes, last/total write wall,
+        and how many snapshots sit queued behind the worker (a rising
+        queue depth means disk is slower than the eval cadence)."""
+        return {
+            "ckpt_queue_depth": float(self._q.qsize()),
+            "ckpt_writes": float(self.writes),
+            "ckpt_last_write_s": self.last_write_s,
+            "ckpt_total_write_s": self.total_write_s,
+        }
 
     def _raise_pending(self):
         if self._errors:
@@ -340,7 +366,8 @@ class AsyncCheckpointer:
         # the other processes blocked inside the allgather: only
         # process 0 ever has pending write errors); only process 0
         # enqueues the write
-        host_state = _snapshot(server, clients, cfg)
+        with telemetry.span("checkpoint.snapshot"):
+            host_state = _snapshot(server, clients, cfg)
         self._raise_pending()
         if not _is_writer_process():
             return

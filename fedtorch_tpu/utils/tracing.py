@@ -143,13 +143,19 @@ def capture_round_trace(log_dir: str, fn: Callable, *args):
     on-chip traces."""
     import os
 
+    from fedtorch_tpu import telemetry
+
     os.makedirs(log_dir, exist_ok=True)
-    jax.profiler.start_trace(log_dir)
-    try:
-        out = fn(*args)
-        fetch_sync(out)
-    finally:
-        jax.profiler.stop_trace()
+    # correlated host-span marker: the profiler window shows up on the
+    # telemetry timeline (trace.json) with the capture dir in its args,
+    # so an operator can line the XLA trace up against the host spans
+    with telemetry.span("profiler.capture", log_dir=log_dir):
+        jax.profiler.start_trace(log_dir)
+        try:
+            out = fn(*args)
+            fetch_sync(out)
+        finally:
+            jax.profiler.stop_trace()
     return out
 
 

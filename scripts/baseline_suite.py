@@ -116,6 +116,9 @@ def run_case(c, dtype):
     )
     from fedtorch_tpu.models import define_model
     from fedtorch_tpu.parallel import FederatedTrainer, evaluate
+    # timed drains fetch-sync (block_until_ready can no-op on the
+    # relay — scripts/bench_timing.py / BASELINE_REPRO.md)
+    from fedtorch_tpu.utils.tracing import fetch_sync
 
     C = c["clients"]
     x, y, tx, ty = synth_data(c["shape"], c["classes"],
@@ -155,14 +158,14 @@ def run_case(c, dtype):
 
     t0 = time.time()
     server, clients, m = trainer.run_round(server, clients)
-    jax.block_until_ready(server.params)
+    fetch_sync(server.params)
     compile_s = time.time() - t0
     first_loss = float(m.train_loss.sum() / m.online_mask.sum())
 
     t0 = time.time()
     for _ in range(c["rounds"] - 1):
         server, clients, m = trainer.run_round(server, clients)
-    jax.block_until_ready(server.params)
+    fetch_sync(server.params)
     dt = max(time.time() - t0, 1e-9)
     n_chips = int(trainer.mesh.devices.size)
     steps = (c["rounds"] - 1) * trainer.k_online * trainer.local_steps

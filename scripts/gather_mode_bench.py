@@ -38,6 +38,9 @@ from fedtorch_tpu.config import (  # noqa: E402
 )
 from fedtorch_tpu.data.batching import stack_partitions  # noqa: E402
 from fedtorch_tpu.models import define_model  # noqa: E402
+# timed drains fetch-sync (block_until_ready can no-op on the
+# relay — scripts/bench_timing.py / BASELINE_REPRO.md)
+from fedtorch_tpu.utils.tracing import fetch_sync  # noqa: E402
 from fedtorch_tpu.parallel import FederatedTrainer  # noqa: E402
 
 # K*B = 160 rows touched per round vs 4000-row shards: 'batch' should
@@ -74,11 +77,11 @@ def build(gather_mode: str):
 def timed(tr) -> tuple[float, float]:
     server, clients = tr.init_state(jax.random.key(0))
     server, clients, _ = tr.run_round(server, clients)
-    jax.block_until_ready(server.params)
+    fetch_sync(server.params)
     t0 = time.time()
     for _ in range(ROUNDS):
         server, clients, _ = tr.run_round(server, clients)
-    jax.block_until_ready(server.params)
+    fetch_sync(server.params)
     dt = (time.time() - t0) / ROUNDS
     loss = float(jax.device_get(
         tr.run_round(server, clients)[2].train_loss).sum())

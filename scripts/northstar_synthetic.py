@@ -61,6 +61,9 @@ def main():
     import jax
 
     from fedtorch_tpu.algorithms import make_algorithm
+    # timed drains fetch-sync (block_until_ready can no-op on the
+    # relay — scripts/bench_timing.py / BASELINE_REPRO.md)
+    from fedtorch_tpu.utils.tracing import fetch_sync
     from fedtorch_tpu.config import (
         DataConfig, ExperimentConfig, FederatedConfig, MeshConfig,
         ModelConfig, OptimConfig, TrainConfig,
@@ -128,7 +131,7 @@ def main():
     for r in range(args.rounds):
         t_r = time.time()
         server, clients, metrics = trainer.run_round(server, clients)
-        jax.block_until_ready(server.params)
+        fetch_sync(server.params)
         train_s += time.time() - t_r
         if (r + 1) % max(args.rounds // 10, 1) == 0 or r == 0:
             res = evaluate(model, server.params, test_x, test_labels,

@@ -20,6 +20,11 @@
 #                        (sync round clock vs FedBuff-style commit
 #                         clock under the straggler-heavy schedule +
 #                         on-chip ms/commit + accuracy parity)
+#   telemetry        scripts/telemetry_bench.py   -> TELEMETRY_AB.json
+#                        (off/default/debug overhead A/B on the
+#                         north-star config, <=1% acceptance) +
+#                         artifacts/telemetry_northstar/ metrics.jsonl
+#                         + Perfetto trace.json capture
 #   conv-ab          BENCH_CONV_IMPL=matmul|conv  (lowering A/B, both)
 #   zoo              scripts/tpu_zoo_check.py     -> TPU_ZOO.json
 #   pallas           scripts/pallas_tpu_check.py  -> PALLAS_TPU.json
@@ -55,8 +60,8 @@ TRIES="${TPU_CAPTURE_WAIT_TRIES:-90}"   # ~6 h of patience by default
 # mfu leads: round 6 is the utilization round — the fused-vs-base A/B
 # and the first-ever on-chip traces are the highest-value capture if
 # the relay wedges mid-list
-DEFAULT_STEPS="mfu stream async bench-streaming bench-dispatch \
-bench-unroll bench zoo pallas flash-train vmap baseline"
+DEFAULT_STEPS="mfu stream async telemetry bench-streaming \
+bench-dispatch bench-unroll bench zoo pallas flash-train vmap baseline"
 STEPS="${*:-$DEFAULT_STEPS}"
 
 echo "[tpu_capture] waiting for the relay (up to ${TRIES}x120s probes)"
@@ -75,6 +80,8 @@ for step in $STEPS; do
         bench-streaming) run env BENCH_STREAMING=1 python bench.py ;;
         stream)         run python scripts/stream_bench.py ;;
         async)          run python scripts/async_bench.py ;;
+        telemetry)      run python scripts/telemetry_bench.py \
+                            --capture-run artifacts/telemetry_northstar ;;
         conv-ab)        run env BENCH_CONV_IMPL=matmul python bench.py
                         run env BENCH_CONV_IMPL=conv python bench.py ;;
         zoo)            run python scripts/tpu_zoo_check.py ;;

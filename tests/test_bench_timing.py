@@ -7,6 +7,7 @@ import os
 
 import jax
 import jax.numpy as jnp
+import pytest
 
 # load the script module without mutating sys.path (same pattern as
 # test_bench_capture.py): a path insert would shadow any test-session
@@ -18,6 +19,7 @@ _spec = importlib.util.spec_from_file_location(
 _mod = importlib.util.module_from_spec(_spec)
 _spec.loader.exec_module(_mod)
 sync, timeit = _mod.sync, _mod.timeit
+timeit_crosscheck = _mod.timeit_crosscheck
 
 
 class TestSync:
@@ -56,3 +58,44 @@ class TestTimeit:
 
         timeit(f, jnp.ones(4), iters=5)
         assert len(calls) == 6  # warmup + iters
+
+    def test_sync_each_mode_calls_and_drains(self):
+        """The opt-in per-iteration-sync cross-check mode (ADVICE
+        round-5): same call count, every iteration drained through a
+        fetch before the next dispatch."""
+        calls = []
+
+        def f(x):
+            calls.append(1)
+            return x + 1
+
+        t = timeit(f, jnp.ones(4), iters=5, sync_each=True)
+        assert t > 0 and len(calls) == 6
+
+
+class TestTimeitCrosscheck:
+    def test_honest_backend_not_suspicious(self):
+        """On a backend that really executes queued work (the CPU
+        mesh), synced-vs-queued stays within the fetch-latency band —
+        far from the 3x suspicion threshold."""
+        f = jax.jit(lambda x: (x @ x).sum())
+        x = jnp.ones((128, 128))
+        r = timeit_crosscheck(f, x, iters=10)
+        assert set(r) == {"queued_s", "synced_s",
+                          "sync_overhead_ratio", "suspect_ratio",
+                          "suspicious"}
+        assert r["queued_s"] > 0 and r["synced_s"] > 0
+        assert r["sync_overhead_ratio"] == pytest.approx(
+            r["synced_s"] / r["queued_s"])
+
+    def test_suspicion_threshold_flags(self):
+        """Positive control: with the threshold dialed below the
+        measured ratio, the same reading flags as suspicious — the
+        ack-without-execute signature detector fires."""
+        f = jax.jit(lambda x: (x @ x).sum())
+        x = jnp.ones((64, 64))
+        honest = timeit_crosscheck(f, x, iters=5,
+                                   suspect_ratio=1e9)
+        assert honest["suspicious"] is False
+        rigged = timeit_crosscheck(f, x, iters=5, suspect_ratio=0.0)
+        assert rigged["suspicious"] is True

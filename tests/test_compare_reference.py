@@ -1,0 +1,97 @@
+"""COMPARE_REFERENCE.json (scripts/compare_reference.py): the
+head-to-head reference claims become machine-checkable (VERDICT item
+8) — schema, derived-field consistency, and the accuracy-delta
+tolerance band are pinned here, against the payload builder/validator
+the script writes through (the full script needs /root/reference
+mounted, so the unit surface is what CI can hold)."""
+import importlib.util
+import json
+import os
+
+import pytest
+
+# load WITHOUT executing main(): the module's import surface is
+# stdlib-only on purpose (constants + shims + payload helpers)
+_spec = importlib.util.spec_from_file_location(
+    "compare_reference", os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts", "compare_reference.py"))
+_mod = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_mod)
+
+build_payload = _mod.build_payload
+validate_payload = _mod.validate_payload
+COMPARE_SCHEMA = _mod.COMPARE_SCHEMA
+ACC_TOLERANCE_PTS = _mod.ACC_TOLERANCE_PTS
+
+GOOD_ROW = {"ref_acc": 78.0, "ours_acc": 77.2, "ref_wall": 120.0,
+            "ours_wall": 12.0, "speedup": 10.0}
+
+
+def payload(**row_overrides):
+    return build_payload(
+        {"fedavg": dict(GOOD_ROW, **row_overrides)}, rounds=30)
+
+
+class TestComparePayload:
+    def test_good_payload_validates_and_serializes(self, tmp_path):
+        p = payload()
+        validate_payload(p)
+        assert p["schema"] == COMPARE_SCHEMA
+        assert p["acc_tolerance_pts"] == ACC_TOLERANCE_PTS
+        # the artifact round-trips through JSON unchanged
+        path = tmp_path / "COMPARE_REFERENCE.json"
+        path.write_text(json.dumps(p))
+        validate_payload(json.loads(path.read_text()))
+
+    def test_schema_mismatch_rejected(self):
+        p = payload()
+        p["schema"] = "fedtorch_tpu.compare_reference/v999"
+        with pytest.raises(ValueError, match="schema"):
+            validate_payload(p)
+
+    def test_empty_rows_rejected(self):
+        with pytest.raises(ValueError, match="no per-algorithm"):
+            validate_payload(build_payload({}, rounds=30))
+
+    def test_missing_field_rejected(self):
+        p = payload()
+        del p["algorithms"]["fedavg"]["speedup"]
+        with pytest.raises(ValueError, match="speedup"):
+            validate_payload(p)
+
+    def test_non_numeric_field_rejected(self):
+        with pytest.raises(ValueError, match="ref_acc"):
+            validate_payload(payload(ref_acc="78%"))
+        # bool is not an accuracy
+        with pytest.raises(ValueError, match="ours_acc"):
+            validate_payload(payload(ours_acc=True))
+
+    def test_inconsistent_speedup_rejected(self):
+        # speedup must equal ref_wall / ours_wall — a hand-edited
+        # artifact cannot overclaim
+        with pytest.raises(ValueError, match="speedup"):
+            validate_payload(payload(speedup=50.0))
+
+    def test_accuracy_delta_outside_tolerance_rejected(self):
+        bad_acc = GOOD_ROW["ref_acc"] - (ACC_TOLERANCE_PTS + 1.0)
+        with pytest.raises(ValueError, match="tolerance"):
+            validate_payload(payload(ours_acc=bad_acc))
+
+    def test_delta_at_tolerance_boundary_accepted(self):
+        validate_payload(
+            payload(ours_acc=GOOD_ROW["ref_acc"] - ACC_TOLERANCE_PTS))
+
+    def test_non_positive_wall_rejected(self):
+        with pytest.raises(ValueError, match="wall"):
+            validate_payload(payload(ours_wall=0.0))
+
+    def test_committed_artifact_validates_if_present(self):
+        # when the capture has run (reference box), the committed
+        # artifact itself must hold the contract
+        path = _mod.OUT_JSON
+        if not os.path.exists(path):
+            pytest.skip("COMPARE_REFERENCE.json not captured yet "
+                        "(needs /root/reference mounted)")
+        with open(path) as f:
+            validate_payload(json.load(f))
