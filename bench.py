@@ -337,20 +337,33 @@ def main():
     log(f"{steps} local steps in {dt:.2f}s over {TIMED_ROUNDS} rounds "
         f"on {n_chips} chip(s)")
 
-    # MFU estimate (analytic): resnet20-cifar forward = 40.8e6 MACs/image
-    # (stem 0.44M + 3 stages x ~13-14M + fc; matches the 41M figure in the
-    # ResNet paper). Training step ~= 3x forward, 2 FLOPs/MAC.
+    # MFU: per-local-step FLOPs from the shared XLA cost-analysis probe
+    # (telemetry.costs — the same numerator mfu_sweep.py reports) when
+    # the timed program is the conv lowering; the analytic resnet20
+    # constant (fwd = 40.8e6 MACs/image, train step ~= 3x fwd, 2
+    # FLOPs/MAC) when the backend reports no costs or the timed row is
+    # the matmul lowering (whose im2col patch extraction must not be
+    # booked as useful work). The record says which via flops_source.
     mfu_pct = None
+    flops_source = None
     if not fallback_cpu:
-        peak_tflops = float(os.environ.get(
-            "BENCH_PEAK_TFLOPS",
-            "197" if dtype == "bfloat16" else "98"))  # TPU v5e per chip
-        train_flops_per_image = 3 * 2 * 40.8e6
-        achieved = steps_per_sec * n_chips * BATCH_SIZE \
-            * train_flops_per_image
+        from fedtorch_tpu.telemetry.costs import (
+            FLOPS_ANALYTIC, FLOPS_XLA, analytic_train_flops_per_image,
+            resolve_peak_tflops, train_step_flops,
+        )
+        peak_tflops, _peak_src = resolve_peak_tflops(dtype)
+        step_flops = train_step_flops(model, BATCH_SIZE) \
+            if cfg.model.conv_impl == "conv" else None
+        flops_source = FLOPS_XLA
+        if step_flops is None:
+            step_flops = BATCH_SIZE * analytic_train_flops_per_image(
+                NORTH_STAR_ARCH)
+            flops_source = FLOPS_ANALYTIC
+        achieved = steps_per_sec * n_chips * step_flops
         mfu_pct = round(100 * achieved / (peak_tflops * 1e12 * n_chips), 2)
         log(f"MFU estimate: {mfu_pct}% of {peak_tflops} TFLOPs/chip "
-            f"({achieved/1e12:.2f} TFLOPs/s achieved; small 32x32 convs "
+            f"({achieved/1e12:.2f} TFLOPs/s achieved, "
+            f"flops={flops_source}; small 32x32 convs "
             f"underfill the MXU — expected for this workload class)")
 
     baseline, baseline_is_live = measure_torch_baseline()
@@ -396,6 +409,7 @@ def main():
     }
     if mfu_pct is not None:
         record["mfu_pct"] = mfu_pct
+        record["flops_source"] = flops_source
 
     if not fallback_cpu and not SMOKE and is_default_bench_config():
         # Persist the live capture for wedged-relay report fallback.
@@ -499,6 +513,8 @@ def _load_fresh_capture(cpu_steps_per_sec: float):
                    "captured_at")}
         if "mfu_pct" in stamp:
             cached["mfu_pct"] = stamp["mfu_pct"]
+        if "flops_source" in stamp:
+            cached["flops_source"] = stamp["flops_source"]
         # Machine-readable provenance: automated consumers must be able
         # to tell a replayed capture from a live measurement without
         # parsing prose (ADVICE r3).

@@ -396,6 +396,31 @@ class AsyncFederatedTrainer(FederatedTrainer):
             "jobs come from the event scheduler) — call run_round once "
             "per commit (docs/robustness.md 'Asynchronous federation')")
 
+    def lowered_cost_programs(self, server, clients,
+                              num_scan_rounds: int = 0):
+        """The async twin of the base trainer's cost-capture handles:
+        the COMMIT program (per data plane), lowered from an
+        uninstrumented twin against abstract [m] job inputs — no
+        scheduler state is consumed and the sentinel sees nothing.
+        ``num_scan_rounds`` is ignored (run_rounds refuses here)."""
+        m = self.buffer_size
+        sds = lambda shape, dt: jax.ShapeDtypeStruct(shape, dt)
+        jobs = CommitJobs(idx=sds((m,), jnp.int32),
+                          version=sds((m,), jnp.int32),
+                          dispatch=sds((m,), jnp.int32),
+                          straggler=sds((m,), jnp.float32))
+        if self.data_plane == "stream":
+            primary = "commit_stream"
+            lowered = jax.jit(
+                self._commit_stream_fn, donate_argnums=(0, 1)).lower(
+                server, clients, jobs, self._feed_struct(k=m))
+        else:
+            primary = "commit"
+            lowered = jax.jit(
+                self._commit_device_fn, donate_argnums=(0, 1)).lower(
+                server, clients, jobs, self.data)
+        return {primary: lowered}, primary
+
     def invalidate_stream(self) -> None:
         """Also drop the event scheduler: any rewrite of host-visible
         training state (supervisor rollback/reseed, resume, drain)

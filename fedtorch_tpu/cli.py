@@ -625,6 +625,29 @@ def run_experiment(cfg: ExperimentConfig,
         # round pays XLA compilation under the same clock.
         watchdog = StallWatchdog(cfg.fault.watchdog_timeout_s, logger=logger)
         watchdog.start()
+        # device-side cost capture (telemetry.costs,
+        # docs/observability.md "Device-side"): process 0 AOT-lowers
+        # uninstrumented twins of the round/commit + eval programs ONCE
+        # after the first round (persistent compile cache warm by then)
+        # and writes program_costs.json; afterwards every metrics row
+        # carries the measured-MFU and HBM-watermark gauges computed
+        # from host state alone — the traced programs never change
+        # (HLO byte-identical, sentinel holds; pinned in
+        # tests/test_device_observability.py)
+        cost_capture = None
+        if tel.enabled and tel.is_writer:
+            from fedtorch_tpu.telemetry.costs import ProgramCostCapture
+            cost_capture = ProgramCostCapture(
+                ckpt_dir, compute_dtype=cfg.mesh.compute_dtype,
+                arch=cfg.model.arch, batch_size=cfg.data.batch_size,
+                local_steps=trainer.local_steps,
+                k_online=trainer.k_online,
+                num_devices=int(trainer.mesh.devices.size),
+                backend=jax.default_backend(),
+                run_meta={"algorithm": cfg.effective_algorithm,
+                          "sync_mode": cfg.federated.sync_mode,
+                          "data_plane": cfg.data.data_plane},
+                log=logger.log)
         # still inside the guard: this fetch can raise too (device
         # fault, poisoned resume state) and must not leak the active
         # telemetry / a 'starting' intent for a dead run
@@ -670,6 +693,33 @@ def run_experiment(cfg: ExperimentConfig,
             # the scalar fetch blocked on the round's results: the
             # round genuinely completed — feed the stall watchdog
             watchdog.heartbeat(r)
+
+            if cost_capture is not None and not cost_capture.captured \
+                    and not cost_capture.load_existing():
+                # once, at the first completed round (elastic restarts
+                # adopt the run dir's existing capture instead — a
+                # resumed run bypasses the compile cache and would pay
+                # a real recompile); a failure turns the device gauges
+                # off, never the run
+                with tel.span("cost_capture", round=r):
+                    try:
+                        programs, primary = \
+                            trainer.lowered_cost_programs(server, clients)
+                        try:
+                            from fedtorch_tpu.parallel.evaluate import (
+                                lowered_eval_program,
+                            )
+                            programs["eval"] = lowered_eval_program(
+                                model, server.params, fed_data.test_x,
+                                fed_data.test_y)
+                        except Exception as e:
+                            logger.log("cost capture: eval program "
+                                       f"skipped ({e})")
+                        cost_capture.capture(programs, primary=primary)
+                    except Exception as e:
+                        cost_capture.captured = True
+                        logger.log(f"cost capture: lowering failed "
+                                   f"({e}); device gauges off")
 
             if cfg.fault.chaos_enabled or cfg.fault.guard_updates:
                 if sc["dropped"] or sc["rejected"] or sc["clipped"] \
@@ -766,6 +816,10 @@ def run_experiment(cfg: ExperimentConfig,
             if checkpoint_s is not None:
                 row["checkpoint_s"] = checkpoint_s
             row.update(trainer.telemetry_gauges())
+            if cost_capture is not None:
+                # measured MFU + HBM watermark pair — empty until the
+                # capture above succeeded, host-side either way
+                row.update(cost_capture.round_gauges(round_time))
             if async_ckpt is not None:
                 row.update(async_ckpt.stats())
             if supervisor is not None:

@@ -123,50 +123,71 @@ def evaluate(model: ModelDef, params, x: np.ndarray, y: np.ndarray,
 
     key = (model.module, model.is_regression, model.is_recurrent)
     if key not in _EVAL_CACHE:
-        def run(params, bx, by, bm):
-            def body(carry, batch):
-                xb, yb, mb = batch
-                if model.is_recurrent:
-                    logits, _ = model.apply(
-                        params, xb, carry=model.init_carry(xb.shape[0]))
-                else:
-                    logits = model.apply(params, xb)
-                if logits.ndim == 3:
-                    # sequence model ([B, T, V] logits, [B, T] targets):
-                    # per-token statistics over the flattened time axis
-                    mb_f = jnp.repeat(mb, yb.shape[-1])
-                    logits = logits.reshape(-1, logits.shape[-1])
-                    yb_f = yb.reshape(-1)
-                else:
-                    yb_f, mb_f = yb, mb
-                # per-sample statistics masked so padding rows (duplicates
-                # of the head of the split) contribute nothing
-                if model.is_regression:
-                    per = jnp.square(logits.reshape(-1) - yb_f)
-                    t1 = t5 = jnp.zeros_like(per)
-                else:
-                    logp = jax.nn.log_softmax(logits)
-                    per = -jnp.take_along_axis(
-                        logp, yb_f[:, None].astype(jnp.int32),
-                        axis=-1)[:, 0]
-                    kmax = min(5, logits.shape[-1])
-                    _, pred = jax.lax.top_k(logits, kmax)
-                    correct = pred == yb_f[:, None].astype(pred.dtype)
-                    t1 = correct[:, 0].astype(jnp.float32)
-                    t5 = jnp.any(correct, axis=1).astype(jnp.float32)
-                return carry, (jnp.sum(per * mb_f), jnp.sum(t1 * mb_f),
-                               jnp.sum(t5 * mb_f), jnp.sum(mb_f))
-
-            _, (losses, t1s, t5s, ws) = jax.lax.scan(body, 0, (bx, by, bm))
-            total = jnp.maximum(jnp.sum(ws), 1e-8)
-            return EvalResult(jnp.sum(losses) / total,
-                              jnp.sum(t1s) / total, jnp.sum(t5s) / total)
-
         # params is the live server model, reused every round
         # lint: disable=FTL004 — live server params, donation unsafe
         _EVAL_CACHE[key] = jax.jit(
-            instrument_trace("evaluate.run", run))
+            instrument_trace("evaluate.run", _eval_run_fn(model)))
     return _EVAL_CACHE[key](params, bx, by, bm)
+
+
+def _eval_run_fn(model: ModelDef):
+    """The eval program body, shared by the cached live jit above and
+    the uninstrumented cost-capture twin (:func:`lowered_eval_program`)
+    so the two lower the same program by construction."""
+    def run(params, bx, by, bm):
+        def body(carry, batch):
+            xb, yb, mb = batch
+            if model.is_recurrent:
+                logits, _ = model.apply(
+                    params, xb, carry=model.init_carry(xb.shape[0]))
+            else:
+                logits = model.apply(params, xb)
+            if logits.ndim == 3:
+                # sequence model ([B, T, V] logits, [B, T] targets):
+                # per-token statistics over the flattened time axis
+                mb_f = jnp.repeat(mb, yb.shape[-1])
+                logits = logits.reshape(-1, logits.shape[-1])
+                yb_f = yb.reshape(-1)
+            else:
+                yb_f, mb_f = yb, mb
+            # per-sample statistics masked so padding rows (duplicates
+            # of the head of the split) contribute nothing
+            if model.is_regression:
+                per = jnp.square(logits.reshape(-1) - yb_f)
+                t1 = t5 = jnp.zeros_like(per)
+            else:
+                logp = jax.nn.log_softmax(logits)
+                per = -jnp.take_along_axis(
+                    logp, yb_f[:, None].astype(jnp.int32),
+                    axis=-1)[:, 0]
+                kmax = min(5, logits.shape[-1])
+                _, pred = jax.lax.top_k(logits, kmax)
+                correct = pred == yb_f[:, None].astype(pred.dtype)
+                t1 = correct[:, 0].astype(jnp.float32)
+                t5 = jnp.any(correct, axis=1).astype(jnp.float32)
+            return carry, (jnp.sum(per * mb_f), jnp.sum(t1 * mb_f),
+                           jnp.sum(t5 * mb_f), jnp.sum(mb_f))
+
+        _, (losses, t1s, t5s, ws) = jax.lax.scan(body, 0, (bx, by, bm))
+        total = jnp.maximum(jnp.sum(ws), 1e-8)
+        return EvalResult(jnp.sum(losses) / total,
+                          jnp.sum(t1s) / total, jnp.sum(t5s) / total)
+
+    return run
+
+
+def lowered_eval_program(model: ModelDef, params, x: np.ndarray,
+                         y: np.ndarray, batch_size: int = 256):
+    """AOT-lower the eval program (an uninstrumented twin of the
+    cached live jit — same body via :func:`_eval_run_fn`, so the HLO
+    is identical) against abstract padded-batch inputs: the ``eval``
+    entry of ``program_costs.json`` (telemetry.costs). Lowering
+    executes nothing on device."""
+    bx, by, bm = _pad_batches(np.asarray(x), np.asarray(y), batch_size)
+    sds = jax.ShapeDtypeStruct
+    return jax.jit(_eval_run_fn(model)).lower(
+        params, sds(bx.shape, bx.dtype), sds(by.shape, by.dtype),
+        sds(bm.shape, bm.dtype))
 
 
 def evaluate_clients(model: ModelDef, client_params, data,

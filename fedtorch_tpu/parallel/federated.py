@@ -1048,23 +1048,83 @@ class FederatedTrainer:
                 "feed — call run_round in a loop (docs/performance.md "
                 "'Streaming data plane')")
         if num_rounds not in self._rounds_jit:
-            def rounds_fn(server, clients, data, val_data):
-                def body(carry, _):
-                    s, c = carry
-                    s, c, m = self.round_fn(s, c, data, val_data)
-                    return (s, c), m
-
-                (s, c), ms = jax.lax.scan(
-                    body, (server, clients), None, length=num_rounds)
-                return s, c, ms
-
             self._rounds_jit[num_rounds] = jax.jit(
                 instrument_trace(
                     f"federated.rounds[{self.algorithm.name}]"
-                    f"x{num_rounds}", rounds_fn),
+                    f"x{num_rounds}", self._build_rounds_fn(num_rounds)),
                 donate_argnums=(0, 1))
         return self._rounds_jit[num_rounds](server, clients, self.data,
                                             self.val_data)
+
+    def _build_rounds_fn(self, num_rounds: int):
+        """The ``run_rounds`` scan driver as a plain function — shared
+        by the live jit above and the uninstrumented cost-capture twin
+        (:meth:`lowered_cost_programs`), so the two lower the same
+        program by construction."""
+        def rounds_fn(server, clients, data, val_data):
+            def body(carry, _):
+                s, c = carry
+                s, c, m = self.round_fn(s, c, data, val_data)
+                return (s, c), m
+
+            (s, c), ms = jax.lax.scan(
+                body, (server, clients), None, length=num_rounds)
+            return s, c, ms
+
+        return rounds_fn
+
+    # -- compiled-program cost capture (telemetry.costs) ------------------
+    def _feed_struct(self, k: Optional[int] = None) -> RoundFeed:
+        """Abstract (shape/dtype/sharding) twin of one packed feed —
+        lets cost capture lower the streamed program without consuming
+        a real prefetched feed from the producer."""
+        st = self.host_store
+        k = self.k_online if k is None else k
+        KB = self.local_steps * self.batch_size
+        sh = replicated_sharding(self.mesh)
+        sds = lambda shape, dt: jax.ShapeDtypeStruct(shape, dt,
+                                                     sharding=sh)
+        fx, fy = st.x.shape[2:], st.y.shape[2:]
+        return RoundFeed(
+            idx=sds((k,), jnp.int32), sizes=sds((k,), st.sizes.dtype),
+            x=sds((k, KB) + fx, st.x.dtype),
+            y=sds((k, KB) + fy, st.y.dtype),
+            pre_x=sds((k, self.batch_size) + fx, st.x.dtype),
+            pre_y=sds((k, self.batch_size) + fy, st.y.dtype))
+
+    def lowered_cost_programs(self, server, clients,
+                              num_scan_rounds: int = 0):
+        """``({name: jax.stages.Lowered}, primary_name)`` for this
+        trainer's jitted programs, AOT-lowered from UNINSTRUMENTED
+        twins of the same functions with the same donation — so the
+        HLO is byte-identical to the live programs' (pinned in
+        tests/test_device_observability.py), the recompilation
+        sentinel sees zero extra trace events, and the live jit caches
+        are untouched. ``primary`` names the per-round program whose
+        FLOPs feed the measured-MFU gauge. ``num_scan_rounds > 0``
+        additionally lowers the ``run_rounds`` scan-of-R driver
+        (device plane only — the bench path's dispatch shape).
+
+        Lowering alone executes no device work; compiling the twins
+        (telemetry.costs.lowered_cost) re-uses the persistent XLA
+        compilation cache the live program already warmed."""
+        programs = {}
+        if self.data_plane == "stream":
+            primary = "round_stream"
+            programs[primary] = jax.jit(
+                self.round_stream_fn, donate_argnums=(0, 1)).lower(
+                server, clients, self._feed_struct())
+        else:
+            primary = "round"
+            programs[primary] = jax.jit(
+                self.round_fn, donate_argnums=(0, 1)).lower(
+                server, clients, self.data, self.val_data)
+            if num_scan_rounds > 0:
+                programs[f"rounds_scan[{num_scan_rounds}]"] = jax.jit(
+                    self._build_rounds_fn(num_scan_rounds),
+                    donate_argnums=(0, 1)).lower(
+                    server, clients, self.data, self.val_data)
+        return programs, primary
 
     def fit(self, rng: jax.Array, num_rounds: Optional[int] = None,
             callback=None):

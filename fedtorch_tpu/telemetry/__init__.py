@@ -29,6 +29,10 @@ Library-code usage (no Telemetry object in scope)::
 """
 from __future__ import annotations
 
+from fedtorch_tpu.telemetry.costs import (  # noqa: F401
+    PROGRAM_COSTS_SCHEMA, ProgramCostCapture, program_costs_path,
+    read_program_costs, resolve_peak_tflops, validate_program_costs,
+)
 from fedtorch_tpu.telemetry.health import (  # noqa: F401
     HealthFile, health_path, read_health,
 )

@@ -74,6 +74,15 @@ METRICS_OPTIONAL = {
     "sup_rollbacks": "supervisor rollbacks so far",
     "sup_retries": "supervisor retries so far",
     "sup_skipped": "supervisor skipped rounds so far",
+    # device-side gauges (telemetry.costs.ProgramCostCapture; present
+    # once program_costs.json was captured — docs/observability.md
+    # "Device-side")
+    "model_flops_utilization": "round-program FLOPs / (round wall x "
+                               "peak x chips) — measured MFU fraction",
+    "hbm_program_peak_bytes": "compiled round program's static device-"
+                              "memory watermark (memory_analysis)",
+    "hbm_live_bytes": "live jax.Array bytes at row time "
+                      "(live_buffer_summary — metadata walk, no sync)",
 }
 
 HEALTH_INTENTS = (

@@ -201,6 +201,56 @@ def render(run_dir: str) -> str:
     return "\n".join(lines)
 
 
+def render_device(run_dir: str) -> str:
+    """The device-side section (docs/observability.md "Device-side"):
+    compiled-program costs from ``program_costs.json`` plus the
+    profiler-trace attribution table over any
+    ``plugins/profile/*/...trace.json(.gz)`` captures under the dir.
+    Works on bare capture dirs too (no metrics.jsonl required)."""
+    from fedtorch_tpu.telemetry.costs import read_program_costs
+    from fedtorch_tpu.tools import trace_attrib
+
+    lines = []
+    costs_seen = False
+    try:
+        doc = read_program_costs(run_dir)
+    except (ValueError, OSError) as e:
+        # the file exists but doesn't validate: surface the actual
+        # error — this dir IS a (broken) capture, not a non-capture
+        doc = None
+        costs_seen = True
+        lines.append(f"program costs: unreadable ({e})")
+    if doc is not None:
+        costs_seen = True
+        lines.append(
+            f"program costs (schema {doc['schema']}, backend "
+            f"{doc['backend']}, peak {doc['peak_tflops_per_chip']} "
+            f"TFLOPs/chip x {doc['num_devices']} [{doc['peak_source']}])")
+        for name, rec in sorted(doc["programs"].items()):
+            fl = rec.get("flops")
+            ba = rec.get("bytes_accessed")
+            pk = rec.get("peak_hbm_bytes")
+            lines.append(
+                f"  {name:<18} flops="
+                f"{f'{fl:.3e}' if fl is not None else 'unreported'}  "
+                f"bytes="
+                f"{_fmt_bytes(ba) if ba is not None else 'unreported'}  "
+                f"peak_hbm="
+                f"{_fmt_bytes(pk) if pk is not None else 'unreported'}"
+                + (f"  [{rec['error']}]" if rec.get("error") else ""))
+        analytic = doc.get("analytic") or {}
+        if analytic.get("round_flops"):
+            lines.append(f"  analytic roofline ({analytic['arch']}): "
+                         f"{analytic['round_flops']:.3e} FLOPs/round")
+    attrib = trace_attrib.attribute(run_dir)
+    lines.append(trace_attrib.render(attrib))
+    if not costs_seen and not attrib.get("categories"):
+        raise FileNotFoundError(
+            f"{run_dir}: neither program_costs.json nor profiler "
+            "trace events found — not a device-observability capture")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="fedtorch-tpu report",
@@ -208,13 +258,26 @@ def main(argv=None) -> int:
                     "(docs/observability.md)")
     p.add_argument("run_dir", help="directory holding metrics.jsonl "
                                    "(or a legacy record0)")
+    p.add_argument("--device", action="store_true",
+                   help="additionally render the device-side section: "
+                        "program_costs.json + profiler-trace "
+                        "attribution (works on bare capture dirs too)")
     args = p.parse_args(argv)
+    rendered = False
     try:
         print(render(args.run_dir))
+        rendered = True
     except FileNotFoundError as e:
-        print(f"report: {e}", file=sys.stderr)
-        return 2
-    return 0
+        if not args.device:
+            print(f"report: {e}", file=sys.stderr)
+            return 2
+    if args.device:
+        try:
+            print(render_device(args.run_dir))
+            rendered = True
+        except (FileNotFoundError, ValueError) as e:
+            print(f"report: {e}", file=sys.stderr)
+    return 0 if rendered else 2
 
 
 if __name__ == "__main__":

@@ -140,7 +140,12 @@ def capture_round_trace(log_dir: str, fn: Callable, *args):
     (block_until_ready can no-op on the relay backend): a trace
     stopped before the device stream finishes records dispatch, not
     execution — the exact failure mode that left round 5 with zero
-    on-chip traces."""
+    on-chip traces.
+
+    The written ``log_dir`` is a capture dir in the sense of
+    ``fedtorch_tpu.tools.trace_attrib`` / ``fedtorch-tpu report
+    --device``: the device-time category attribution runs directly on
+    it (docs/observability.md "Device-side")."""
     import os
 
     from fedtorch_tpu import telemetry

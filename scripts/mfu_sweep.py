@@ -70,6 +70,11 @@ sys.path.insert(
 
 import numpy as np
 
+from fedtorch_tpu.telemetry.costs import (
+    FLOPS_ANALYTIC, FLOPS_XLA, analytic_train_flops_per_image,
+    resolve_peak_tflops, train_step_flops,
+)
+
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
@@ -80,7 +85,7 @@ def log(*a):
 NUM_CLIENTS = int(os.environ.get("MFU_CLIENTS", "100"))
 LOCAL_STEPS = int(os.environ.get("MFU_STEPS", "10"))
 TIMED_ROUNDS = int(os.environ.get("MFU_ROUNDS", "5"))
-TRAIN_FLOPS_PER_IMAGE = 3 * 2 * 40.8e6  # bench.py's accounting
+TRAIN_FLOPS_PER_IMAGE = analytic_train_flops_per_image("resnet20")
 
 
 _FLOPS_CACHE = {}
@@ -89,38 +94,20 @@ _FLOPS_CACHE = {}
 def measured_flops_per_step(model, batch, cache_key=None):
     """Per-local-step training FLOPs from XLA's own cost analysis of
     the compiled fwd+bwd (the compiled truth, vs the hand-derived
-    resnet20 constant). None when the backend doesn't report flops
-    (any failure is absorbed — a lost FLOPs count must never lose the
-    config's timing). Memoized on ``cache_key`` so grid configs that
-    share (arch, batch, dtype) pay one compile (callers always pass
-    the conv-lowering model, whatever the timed row's conv_impl)."""
+    resnet20 constant) — delegated to the ONE shared probe,
+    ``telemetry.costs.train_step_flops``, so every bench reports the
+    same ``flops_source`` accounting. None when the backend doesn't
+    report flops (any failure is absorbed — a lost FLOPs count must
+    never lose the config's timing). Memoized on ``cache_key`` so grid
+    configs that share (arch, batch, dtype) pay one compile (callers
+    always pass the conv-lowering model, whatever the timed row's
+    conv_impl)."""
     if cache_key is not None and cache_key in _FLOPS_CACHE:
         return _FLOPS_CACHE[cache_key]
-    import jax
-    import jax.numpy as jnp
-
-    from fedtorch_tpu.core.losses import softmax_cross_entropy
-
-    try:
-        # the ModelDef's own sample input (built for this batch size);
-        # zeros labels are shape-correct for any classification arch
-        x = model.sample_input
-        y = jnp.zeros((batch,), jnp.int32)
-        params = model.init(jax.random.key(0))
-
-        def loss(p):
-            return softmax_cross_entropy(model.apply(p, x), y)
-
-        compiled = jax.jit(jax.grad(loss)).lower(params).compile()
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0]
-        fl = float(ca.get("flops", 0.0))
-        out = fl if fl > 0 else None
-    except Exception as e:
-        log(f"cost_analysis unavailable ({e}); using the analytic "
-            "constant where applicable")
-        out = None
+    out = train_step_flops(model, batch)
+    if out is None:
+        log("cost_analysis unavailable; using the analytic constant "
+            "where applicable")
     if cache_key is not None:
         _FLOPS_CACHE[cache_key] = out
     return out
@@ -210,9 +197,7 @@ def run_config(name, *, batch, dtype="bfloat16", unroll=1,
     n_chips = int(trainer.mesh.devices.size)
     steps = TIMED_ROUNDS * trainer.k_online * trainer.local_steps
     steps_per_sec = steps / dt / n_chips
-    peak_tflops = float(os.environ.get(
-        "BENCH_PEAK_TFLOPS",
-        "197" if dtype == "bfloat16" else "98"))
+    peak_tflops, _peak_src = resolve_peak_tflops(dtype)
     # FLOPs per local step: XLA cost analysis of the compiled fwd+bwd
     # when available (exact for ANY arch), else the analytic resnet20
     # constant; configs with neither report no MFU rather than a made-up
@@ -230,11 +215,11 @@ def run_config(name, *, batch, dtype="bfloat16", unroll=1,
         flops_model = define_model(flops_cfg, batch_size=batch)
     step_flops = measured_flops_per_step(
         flops_model, batch, cache_key=(arch, batch, dtype))
-    flops_src = "xla_cost_analysis"
+    flops_src = FLOPS_XLA
     if step_flops is None:
         if arch == "resnet20":
             step_flops = batch * TRAIN_FLOPS_PER_IMAGE
-            flops_src = "analytic_resnet20"
+            flops_src = FLOPS_ANALYTIC
         else:
             flops_src = None
     row = {

@@ -34,8 +34,11 @@
 #   mfu              MFU_PROFILE=1 scripts/mfu_sweep.py
 #                        -> MFU_SWEEP.json (now incl. the client-fused
 #                           configs) + artifacts/trace_northstar{,_fused}
-#                           on-chip profiler traces (the round-5 verdict
-#                           notes none has ever been captured)
+#                           on-chip profiler traces, piped through
+#                           tools/trace_attrib into
+#                           artifacts/attrib_northstar{,_fused}.json/.txt
+#                           (the device-time category table —
+#                           docs/observability.md "Device-side")
 #   moe              scripts/moe_ab_bench.py      -> MOE_AB.json
 #   seqpar           scripts/seqpar_tpu_probe.py  -> SEQPAR_TPU_PROBE.json
 #   baseline         scripts/baseline_suite.py    -> BASELINE_SUITE.json
@@ -89,7 +92,18 @@ for step in $STEPS; do
         flash-train)    run python scripts/flash_train_bench.py ;;
         flash-sweep)    run python scripts/flash_block_sweep.py ;;
         vmap)           run python scripts/vmap_penalty_bench.py ;;
-        mfu)            run env MFU_PROFILE=1 python scripts/mfu_sweep.py ;;
+        mfu)            run env MFU_PROFILE=1 python scripts/mfu_sweep.py
+                        # pipe the armed on-chip traces straight through
+                        # the attributor: the capture yields the
+                        # category table without a second relay trip
+                        run python -m fedtorch_tpu.tools.trace_attrib \
+                            artifacts/trace_northstar \
+                            --out artifacts/attrib_northstar.json \
+                            --render artifacts/attrib_northstar.txt
+                        run python -m fedtorch_tpu.tools.trace_attrib \
+                            artifacts/trace_northstar_fused \
+                            --out artifacts/attrib_northstar_fused.json \
+                            --render artifacts/attrib_northstar_fused.txt ;;
         moe)            run python scripts/moe_ab_bench.py ;;
         seqpar)         run python scripts/seqpar_tpu_probe.py ;;
         baseline)       run python scripts/baseline_suite.py ;;

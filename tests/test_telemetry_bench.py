@@ -31,11 +31,14 @@ def test_telemetry_bench_smoke(tmp_path):
     assert proc.returncode in (0, 1), proc.stderr[-2000:]
     with open(out_path) as f:
         report = json.load(f)
-    assert set(report["arms"]) == {"off", "default", "debug"}
+    assert set(report["arms"]) == {"off", "default", "costs", "debug"}
     for arm in report["arms"].values():
         assert arm["per_round_s"] > 0
         assert len(arm["reps_ms_per_round"]) == 2
     assert "overhead_frac" in report["arms"]["default"]
+    # the costs arm (device MFU+HBM gauges on) is measured against the
+    # same bar (ISSUE 8)
+    assert "overhead_frac" in report["arms"]["costs"]
     # unit costs prove the emitters themselves stay micro-scale even
     # when the A/B arms are noise-bound
     uc = report["unit_costs"]
