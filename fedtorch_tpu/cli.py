@@ -255,6 +255,42 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fault_nan_inject_rate", type=float, default=0.0,
                    help="per-round probability an online client uploads "
                         "a NaN-poisoned delta (exercises the guards)")
+    p.add_argument("--fault_byzantine_rate", type=float, default=0.0,
+                   help="per-round probability an online client is an "
+                        "ADVERSARY: its upload is replaced by a crafted "
+                        "finite vector that passes the benign-fault "
+                        "guards (the robust_agg layer is the defense)")
+    p.add_argument("--fault_byzantine_mode", default="sign_flip",
+                   choices=("sign_flip", "scale", "zero", "gauss",
+                            "collude"),
+                   help="attack shape: sign_flip=-scale*delta, "
+                        "scale=norm inflation, zero=free-rider, "
+                        "gauss=pure noise, collude=all byzantine "
+                        "clients submit the identical "
+                        "-scale*(honest mean) update")
+    p.add_argument("--fault_byzantine_scale", type=float, default=1.0,
+                   help="attack magnitude multiplier")
+    p.add_argument("--robust_agg", default="mean",
+                   choices=("mean", "median", "trimmed_mean", "krum",
+                            "multikrum", "norm_bound"),
+                   help="aggregation rule at the round/commit seam "
+                        "(robustness/aggregators.py): 'mean' (default) "
+                        "is the pre-robust weighted sum, bitwise-"
+                        "identical; median/trimmed_mean are "
+                        "coordinate-wise (Yin et al. 2018), "
+                        "krum/multikrum pairwise-distance selection "
+                        "(Blanchard et al. 2017), norm_bound centered "
+                        "clipping with a server momentum "
+                        "(Karimireddy et al. 2021). Composes after "
+                        "guards/chaos and async staleness weights on "
+                        "BOTH federation planes")
+    p.add_argument("--robust_trim_frac", type=float, default=0.1,
+                   help="trimmed_mean's per-end trim fraction and "
+                        "krum's assumed byzantine fraction")
+    p.add_argument("--robust_norm_tau", type=float, default=1.5,
+                   help="norm_bound clip radius as a multiple of the "
+                        "median distance-to-momentum (1.5: adversaries "
+                        "clamp hard, clustered honest updates barely)")
     p.add_argument("--guard_updates", type=str2bool, default=False,
                    help="screen client deltas before aggregation: "
                         "reject non-finite, reject/clip norm-exploded")
@@ -449,6 +485,12 @@ def args_to_config(args) -> ExperimentConfig:
             straggler_rate=args.fault_straggler_rate,
             straggler_step_frac=args.fault_straggler_step_frac,
             nan_inject_rate=args.fault_nan_inject_rate,
+            byzantine_rate=args.fault_byzantine_rate,
+            byzantine_mode=args.fault_byzantine_mode,
+            byzantine_scale=args.fault_byzantine_scale,
+            robust_agg=args.robust_agg,
+            robust_trim_frac=args.robust_trim_frac,
+            robust_norm_tau=args.robust_norm_tau,
             guard_updates=args.guard_updates,
             guard_norm_multiplier=args.guard_norm_multiplier,
             guard_mode=args.guard_mode,
@@ -617,6 +659,9 @@ def run_experiment(cfg: ExperimentConfig,
         # decision is SPMD-agreed via the per-round scalar fetch; the
         # watchdog is host-only and off by default (watchdog_timeout_s=0).
         from fedtorch_tpu.robustness import PreemptionHandler, StallWatchdog
+        from fedtorch_tpu.robustness.guards import (
+            all_rejected_scalars as _all_rejected,
+        )
         preempt = PreemptionHandler(logger=logger)
         preempt.install()
         trainer.attach_stop_signal(lambda: preempt.stop_requested)
@@ -660,6 +705,7 @@ def run_experiment(cfg: ExperimentConfig,
         raise
     results = {}
     loop_raised = False
+    byz_attack_seen = False
     try:
         for r in range(start_round, cfg.federated.num_comms):
             timer.new_round()
@@ -723,13 +769,35 @@ def run_experiment(cfg: ExperimentConfig,
 
             if cfg.fault.chaos_enabled or cfg.fault.guard_updates:
                 if sc["dropped"] or sc["rejected"] or sc["clipped"] \
-                        or sc["stragglers"]:
+                        or sc["stragglers"] or sc["byzantine"]:
                     logger.log(
                         f"Round {r}: faults — "
                         f"dropped={sc['dropped']:.0f} "
                         f"stragglers={sc['stragglers']:.0f} "
                         f"rejected={sc['rejected']:.0f} "
-                        f"clipped={sc['clipped']:.0f}")
+                        f"clipped={sc['clipped']:.0f} "
+                        f"byzantine={sc['byzantine']:.0f}")
+                if sc["byzantine"] and not byz_attack_seen:
+                    # one attack event per run, at the first observed
+                    # injection — monitors key on this, not on scanning
+                    # every row's counter
+                    byz_attack_seen = True
+                    tel.event("chaos.byzantine_attack", round=r,
+                              mode=cfg.fault.byzantine_mode,
+                              rate=cfg.fault.byzantine_rate,
+                              scale=cfg.fault.byzantine_scale,
+                              robust_agg=cfg.fault.robust_agg)
+                if supervisor is None and _all_rejected(sc):
+                    # renorm scale hit 0: every surviving update was
+                    # rejected (or every client crashed) — the server
+                    # held this round. With a supervisor the same
+                    # detection runs inside its health path.
+                    logger.log(f"Round {r}: guards rejected EVERY "
+                               "update — server held (renorm scale 0)")
+                    tel.event("guards.all_rejected", round=r,
+                              n_online=sc["n_online"],
+                              rejected=sc["rejected"],
+                              dropped=sc["dropped"])
 
             if cfg.checkpoint.check_model_at_sync:
                 norms = jax.device_get(model_norms(server.params))
@@ -806,6 +874,9 @@ def run_experiment(cfg: ExperimentConfig,
                 "stragglers": sc["stragglers"],
                 "rejected": sc["rejected"], "clipped": sc["clipped"],
                 "staleness": sc["staleness"],
+                "byzantine": sc["byzantine"],
+                "robust_selected": sc["robust_selected"],
+                "robust_trimmed": sc["robust_trimmed"],
             }
             if eval_s is not None:
                 row["eval_s"] = eval_s
@@ -930,6 +1001,7 @@ def run_experiment(cfg: ExperimentConfig,
             "rollbacks": st.rollbacks,
             "skipped_rounds": st.skipped_rounds,
             "disk_restores": st.disk_restores,
+            "all_rejected_rounds": st.all_rejected_rounds,
             "last_good_round": st.last_good_round}
         if st.rollbacks:
             logger.log(f"supervisor: {st.rollbacks} rollback(s), "

@@ -24,6 +24,20 @@ from typing import Optional
 # (ref: parameters.py:257-259).
 PERSONALIZED_ALGORITHMS = ("apfl", "perfedme", "perfedavg")
 
+# Robust aggregation rules at the round/commit aggregation seam
+# (robustness/aggregators.py; 'mean' = the pre-robustness weighted sum)
+# and the in-jit byzantine adversary models that test them
+# (robustness/chaos.py). Declared here so config validation stays
+# stdlib-only — the jax implementations import THESE tuples.
+ROBUST_AGGREGATORS = ("mean", "median", "trimmed_mean", "krum",
+                      "multikrum", "norm_bound")
+BYZANTINE_MODES = ("sign_flip", "scale", "zero", "gauss", "collude")
+# norm_bound carries a params-shaped server momentum in server.aux;
+# algorithms with structured payload trees (SCAFFOLD's control deltas,
+# qFFL's fairness scalar, DRFA's nested wrapper) have no single tree
+# the momentum can live against, so they raise at construction.
+NORM_BOUND_ALGORITHMS = ("fedavg", "fedprox", "fedadam")
+
 FEDERATED_ALGORITHMS = (
     "fedavg", "scaffold", "fedprox", "fedgate", "fedadam", "apfl", "afl",
     "perfedavg", "qsparse", "perfedme", "qffl",
@@ -340,6 +354,42 @@ class FaultConfig:
     # sampling/training streams (fixed; exposed for reproducibility
     # experiments that want distinct chaos schedules on one data seed)
     chaos_salt: int = 0x7FFFFFFD
+    # -- byzantine adversary model (robustness/chaos.py) ----------------
+    # fraction of the population that is a FIXED adversarial cohort
+    # (floor(rate * num_clients) clients, chosen once per run from the
+    # run key — persistent adversaries, not per-round coin flips).
+    # Whenever a cohort member is online its upload is replaced at the
+    # wire by a crafted vector per byzantine_mode. Unlike nan poison,
+    # the crafted upload is FINITE and (for sign_flip/collude at scale
+    # 1) carries an honest-sized norm — it passes the update guards by
+    # design; the defense is the robust aggregation layer (robust_agg).
+    byzantine_rate: float = 0.0
+    # sign_flip: -scale*delta | scale: scale*delta | zero: free-rider |
+    # gauss: scale*N(0,I) noise | collude: all byzantine clients submit
+    # the identical -scale*(honest weighted-mean update)
+    byzantine_mode: str = "sign_flip"
+    # attack magnitude multiplier (see byzantine_mode semantics)
+    byzantine_scale: float = 1.0
+    # -- robust aggregation (robustness/aggregators.py) -----------------
+    # aggregation rule at the round/commit seam: 'mean' (default; the
+    # pre-robustness weighted sum, bitwise-identical), coordinate-wise
+    # 'median', 'trimmed_mean' (robust_trim_frac off each end),
+    # 'krum'/'multikrum' (pairwise-distance selection as a weight
+    # mask), 'norm_bound' (centered clipping toward a server momentum
+    # carried in server.aux). Composes AFTER the chaos/guard accept
+    # mask and the async staleness weights.
+    robust_agg: str = "mean"
+    # trimmed_mean's per-end trim fraction AND krum's assumed byzantine
+    # fraction f/k (the rules tolerate strictly fewer adversaries than
+    # this fraction of the accepted updates)
+    robust_trim_frac: float = 0.1
+    # norm_bound clip radius as a multiple of the round's median
+    # distance-to-momentum (scale-free, like guard_norm_multiplier).
+    # Default 1.5: honest updates cluster near the momentum so mild
+    # clipping is benign, while a permissive radius lets an adversary
+    # ride exactly at the boundary — the attack matrix measured tau=3
+    # failing against scale-3 sign flips that tau<=2 fully stops.
+    robust_norm_tau: float = 1.5
     # -- server-side update guards -------------------------------------
     # screen client deltas before aggregation: non-finite deltas are
     # always rejected; finite deltas whose global l2 norm exceeds
@@ -374,7 +424,8 @@ class FaultConfig:
     @property
     def chaos_enabled(self) -> bool:
         return (self.client_drop_rate > 0.0 or self.straggler_rate > 0.0
-                or self.nan_inject_rate > 0.0)
+                or self.nan_inject_rate > 0.0
+                or self.byzantine_rate > 0.0)
 
 
 @dataclass(frozen=True)
@@ -573,10 +624,39 @@ class ExperimentConfig:
                 f"got {self.mesh.client_fusion!r}")
         flt = self.fault
         for name in ("client_drop_rate", "straggler_rate",
-                     "nan_inject_rate"):
+                     "nan_inject_rate", "byzantine_rate"):
             v = getattr(flt, name)
             if not 0.0 <= v <= 1.0:
                 raise ValueError(f"fault.{name} must be in [0, 1], got {v}")
+        if flt.byzantine_mode not in BYZANTINE_MODES:
+            raise ValueError(
+                f"fault.byzantine_mode must be one of {BYZANTINE_MODES}, "
+                f"got {flt.byzantine_mode!r}")
+        if flt.byzantine_scale <= 0.0:
+            raise ValueError(
+                "fault.byzantine_scale must be > 0, got "
+                f"{flt.byzantine_scale}")
+        if flt.robust_agg not in ROBUST_AGGREGATORS:
+            raise ValueError(
+                f"fault.robust_agg must be one of {ROBUST_AGGREGATORS}, "
+                f"got {flt.robust_agg!r}")
+        if not 0.0 <= flt.robust_trim_frac < 0.5:
+            raise ValueError(
+                "fault.robust_trim_frac must be in [0, 0.5) (trimming "
+                "half or more from each end leaves nothing), got "
+                f"{flt.robust_trim_frac}")
+        if flt.robust_norm_tau <= 0.0:
+            raise ValueError(
+                "fault.robust_norm_tau must be > 0, got "
+                f"{flt.robust_norm_tau}")
+        if flt.robust_agg == "norm_bound" and fed.federated \
+                and self.effective_algorithm not in NORM_BOUND_ALGORITHMS:
+            raise ValueError(
+                "fault.robust_agg='norm_bound' carries a params-shaped "
+                "server momentum; algorithm "
+                f"{self.effective_algorithm!r} uses a structured payload "
+                "tree the momentum cannot live against (supported: "
+                f"{', '.join(NORM_BOUND_ALGORITHMS)})")
         if not 0.0 < flt.straggler_step_frac <= 1.0:
             raise ValueError(
                 "fault.straggler_step_frac must be in (0, 1], got "

@@ -56,6 +56,13 @@ class RoundMetrics(NamedTuple):
     # async commit plane only: mean commit-version staleness of the
     # buffered updates this commit consumed (0 on the sync planes)
     staleness_mean: jnp.ndarray = 0.0     # scalar
+    # byzantine adversary + robust aggregation (robustness/chaos.py,
+    # robustness/aggregators.py): adversarial uploads injected this
+    # round, updates the robust rule aggregated, and updates it
+    # excluded/clipped beyond the guards. All 0 when off.
+    byzantine_clients: jnp.ndarray = 0.0  # scalar — crafted uploads
+    robust_selected: jnp.ndarray = 0.0    # scalar — updates aggregated
+    robust_trimmed: jnp.ndarray = 0.0     # scalar — excluded/clipped
 
 
 def tree_where(pred, on_true, on_false):

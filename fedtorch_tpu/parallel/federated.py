@@ -40,10 +40,16 @@ Design (SURVEY.md §7):
 * Fault tolerance (docs/robustness.md): ``cfg.fault`` drives a
   deterministic in-program chaos layer (client crashes masked out of
   aggregation with weight renormalization, straggler step cuts on the
-  epoch-sync freeze mask, NaN-poisoned uploads) and server-side update
-  guards (non-finite / norm-exploded deltas rejected or clipped before
-  the sum). All gating is static config — faults off traces the exact
-  fault-free program.
+  epoch-sync freeze mask, NaN-poisoned uploads, byzantine adversaries
+  crafting finite wire uploads) and server-side update guards
+  (non-finite / norm-exploded deltas rejected or clipped before the
+  sum). ``cfg.fault.robust_agg`` swaps the aggregation seam for a
+  byzantine-robust rule (coordinate median, trimmed mean,
+  krum/multikrum selection, centered norm-bounding —
+  robustness/aggregators.py) shared by the sync round and the async
+  commit. All gating is static config — faults off traces the exact
+  fault-free program and ``robust_agg='mean'`` the exact pre-robust
+  aggregation.
 """
 from __future__ import annotations
 
@@ -80,8 +86,10 @@ from fedtorch_tpu.parallel.mesh import (
     client_sharding, make_mesh, padded_client_count, replicate,
     replicated_sharding, shard_clients,
 )
+from fedtorch_tpu.robustness.aggregators import robust_aggregate
 from fedtorch_tpu.robustness.chaos import (
-    draw_chaos_plan, no_chaos_plan, poison_tree,
+    BYZ_COHORT_FOLD, BYZ_NOISE_FOLD, apply_byzantine,
+    byzantine_cohort_mask, draw_chaos_plan, no_chaos_plan, poison_tree,
 )
 from fedtorch_tpu.robustness.guards import (
     renormalize_accepted, screen_payloads,
@@ -156,6 +164,15 @@ class FederatedTrainer:
         self.chaos_on = cfg.fault.chaos_enabled
         self.guard_on = cfg.fault.guard_updates
         self.mask_steps = self.epoch_sync or cfg.fault.straggler_rate > 0.0
+        # robust aggregation (robustness/aggregators.py): the rule is
+        # static config, so 'mean' (default) traces the aggregation
+        # seam byte-identically to the pre-robust engine. 'norm_bound'
+        # carries a params-shaped server momentum: server.aux is
+        # wrapped {'alg': <algorithm aux>, 'norm_bound_m': <tree>} by
+        # init_state and unwrapped at the top of _round_core (the async
+        # ring wraps OUTSIDE this, so the two compose).
+        self.robust_rule = cfg.fault.robust_agg
+        self.robust_momentum = self.robust_rule == "norm_bound"
 
         # 'batch' gathers only the K*B rows each online client will touch
         # this round (bounds cross-device movement when K*B < shard
@@ -305,6 +322,12 @@ class FederatedTrainer:
                 local_index=jnp.zeros((), jnp.int32))
 
         clients = jax.vmap(one_client)(jnp.arange(C))
+        if self.robust_momentum:
+            # the norm_bound center starts at zero (first round clips
+            # toward the origin at the median-update radius)
+            server = server._replace(aux={
+                "alg": server.aux,
+                "norm_bound_m": tree_zeros_like(params)})
         return replicate(server, self.mesh), \
             shard_clients(clients, self.mesh)
 
@@ -322,8 +345,12 @@ class FederatedTrainer:
         rng_round = jax.random.fold_in(server.rng, server.round)
         rng_sample, rng_train = jax.random.split(rng_round)
 
+        # participation hooks read the ALGORITHM aux (DRFA's lambda),
+        # not the norm_bound momentum wrap
+        part_aux = server.aux["alg"] if self.robust_momentum \
+            else server.aux
         idx = alg.participation(rng_sample, C, self.k_online, server.round,
-                                server.aux)
+                                part_aux)
         if idx is None:
             idx = participation_indices(rng_sample, C, self.k_online,
                                         server.round)
@@ -425,6 +452,18 @@ class FederatedTrainer:
         ``plan`` substitutes a caller-built chaos plan (async stragglers
         are arrival DELAYS, not step cuts). All four default to None,
         which traces exactly the synchronous program."""
+        # norm_bound robust aggregation carries its server momentum in
+        # server.aux ({'alg': ..., 'norm_bound_m': ...}); every
+        # algorithm hook below reads the unwrapped ALG aux. The async
+        # ring wraps outside this layer, so a stacked base_aux from the
+        # snapshot ring unwraps the same way.
+        if self.robust_momentum:
+            robust_m = server.aux["norm_bound_m"]
+            server = server._replace(aux=server.aux["alg"])
+            if base_aux is not None:
+                base_aux = base_aux["alg"]
+        else:
+            robust_m = None
         cfg, model, alg = self.cfg, self.model, self.algorithm
         K, B, C = self.local_steps, self.batch_size, self.num_clients
         # the online axis length: k_online for the sync planes, the
@@ -447,6 +486,15 @@ class FederatedTrainer:
             plan = draw_chaos_plan(
                 jax.random.fold_in(rng_round, flt.chaos_salt),
                 k, flt) if self.chaos_on else no_chaos_plan(k)
+        if flt.byzantine_rate > 0.0:
+            # the adversarial cohort is FIXED per run (server.rng is
+            # threaded unchanged through every round, so the fold is
+            # round-independent); the plan carries its online slice.
+            # Applies to caller-built plans too (the async commit).
+            cohort = byzantine_cohort_mask(
+                jax.random.fold_in(server.rng, BYZ_COHORT_FOLD),
+                C, flt.byzantine_rate)
+            plan = plan._replace(byzantine=jnp.take(cohort, idx))
 
         # gather online-client state (the per-round new_group)
         take = lambda t: jax.tree.map(lambda x: jnp.take(x, idx, axis=0), t)
@@ -620,14 +668,28 @@ class FederatedTrainer:
               on_sizes, on_vsizes, weights, rngs,
               plan.budget_scale, base_p_in, base_a_in)
 
-        # poison chaos: the client's UPLOAD goes non-finite (its local
-        # state stays sane — the fault is at the wire, so ``deltas``
-        # itself must stay clean: client_post consumes it for persistent
-        # aux updates like FedGATE's tracking variate). ``wire_deltas``
-        # is what the guards judge — the poisoned view the server saw.
+        # wire-level adversaries and faults: the clients' local state
+        # stays sane (``deltas`` itself must stay clean: client_post
+        # consumes it for persistent aux updates like FedGATE's
+        # tracking variate); ``wire_deltas`` is what the guards judge —
+        # the corrupted view the server saw. The byzantine swap comes
+        # FIRST (an adversary crafts what it sends, then the wire
+        # format applies like any client's); nan poison last (a fried
+        # wire trumps whatever was on it).
         wire_deltas = deltas
+        byz_count = jnp.zeros(())
+        if flt.byzantine_rate > 0.0:
+            byz_rng = jax.random.fold_in(
+                jax.random.fold_in(rng_round, flt.chaos_salt),
+                BYZ_NOISE_FOLD)
+            wire_deltas, payloads = apply_byzantine(
+                plan, wire_deltas, payloads, weights, byz_rng, flt)
+            # count uploads that actually REACH the server: a cohort
+            # member that also crash-chaosed never uploads, so its
+            # crafted payload is not an injected attack
+            byz_count = jnp.sum(plan.byzantine * plan.survive)
         if flt.nan_inject_rate > 0.0:
-            wire_deltas = poison_tree(deltas, plan.nan_inject)
+            wire_deltas = poison_tree(wire_deltas, plan.nan_inject)
 
         # uplink wire format on the stacked [k] payload axis (per-client
         # quantization via the pallas client-grid kernel — outside the
@@ -654,17 +716,33 @@ class FederatedTrainer:
         else:
             accept = None
 
-        # the aggregation collective: sum over the (sharded) client axis,
-        # then the downlink wire-format transform applied ONCE so the
-        # server step and client_post see the same (e.g. re-quantized) sum
-        payload_sum = jax.tree.map(lambda p: jnp.sum(p, axis=0), payloads)
-        if accept is not None:
-            # rejected weight redistributed over survivors; all-rejected
-            # rounds contribute a zero payload (server holds). Staleness
-            # weights (weight_scale) are already composed into
-            # ``weights``, so they renormalize with it (guards.py).
-            payload_sum = renormalize_accepted(payload_sum, weights,
-                                               accept)
+        # the aggregation seam: either the plain weighted sum (the
+        # pre-robust engine, kept verbatim so --robust_agg mean stays
+        # bitwise-identical) or a byzantine-robust rule over the same
+        # stacked payloads (robustness/aggregators.py), composing AFTER
+        # the chaos/guard accept mask and the async staleness weights;
+        # the downlink wire-format transform applies ONCE either way so
+        # the server step and client_post see the same sum
+        robust_selected = robust_trimmed = jnp.zeros(())
+        new_robust_m = robust_m
+        if self.robust_rule != "mean":
+            accept_f = accept if accept is not None else jnp.ones((k,))
+            payload_sum, new_robust_m, rreport = robust_aggregate(
+                self.robust_rule, payloads, weights, accept_f, flt,
+                momentum=robust_m)
+            robust_selected = rreport.selected
+            robust_trimmed = rreport.trimmed
+        else:
+            payload_sum = jax.tree.map(lambda p: jnp.sum(p, axis=0),
+                                       payloads)
+            if accept is not None:
+                # rejected weight redistributed over survivors;
+                # all-rejected rounds contribute a zero payload (server
+                # holds). Staleness weights (weight_scale) are already
+                # composed into ``weights``, so they renormalize with
+                # it (guards.py).
+                payload_sum = renormalize_accepted(payload_sum, weights,
+                                                   accept)
         payload_sum = alg.aggregate_transform(payload_sum)
 
         new_params, new_opt, new_saux = alg.server_update(
@@ -729,6 +807,11 @@ class FederatedTrainer:
         # second global phase with data access (DRFA dual update)
         new_server = alg.post_round_global(
             new_server, data, jax.random.fold_in(rng_round, 99))
+        if self.robust_momentum:
+            # re-wrap: the updated norm_bound center rides server.aux
+            # through checkpoints and the async snapshot ring unchanged
+            new_server = new_server._replace(aux={
+                "alg": new_server.aux, "norm_bound_m": new_robust_m})
         metrics = RoundMetrics(
             train_loss=loss_full, train_acc=acc_full,
             online_mask=mask_full, comm_bytes=comm_bytes,
@@ -736,7 +819,10 @@ class FederatedTrainer:
             straggler_clients=jnp.sum(
                 (plan.budget_scale < 1.0).astype(jnp.float32)),
             rejected_updates=jnp.asarray(rejected, jnp.float32),
-            clipped_updates=jnp.asarray(clipped, jnp.float32))
+            clipped_updates=jnp.asarray(clipped, jnp.float32),
+            byzantine_clients=jnp.asarray(byz_count, jnp.float32),
+            robust_selected=jnp.asarray(robust_selected, jnp.float32),
+            robust_trimmed=jnp.asarray(robust_trimmed, jnp.float32))
         return new_server, new_clients, metrics
 
     # -- fused client round (cfg.mesh.client_fusion='fused') --------------
@@ -925,6 +1011,11 @@ class FederatedTrainer:
             # async commit plane: mean snapshot staleness this commit
             # consumed (0.0 on the sync planes) — riding the same fetch
             "staleness": metrics.staleness_mean,
+            # byzantine adversary + robust aggregation counters (0 when
+            # off) — same single batched fetch
+            "byzantine": metrics.byzantine_clients,
+            "robust_selected": metrics.robust_selected,
+            "robust_trimmed": metrics.robust_trimmed,
         }
         if self._stop_signal is not None:
             out["stop"] = self.stop_flag_dev(bool(self._stop_signal()))

@@ -1,5 +1,9 @@
+from fedtorch_tpu.robustness.aggregators import (  # noqa: F401
+    ROBUST_AGGREGATORS, RobustReport, krum_selection, robust_aggregate,
+)
 from fedtorch_tpu.robustness.chaos import (  # noqa: F401
-    ChaosPlan, draw_chaos_plan,
+    BYZANTINE_MODES, ChaosPlan, apply_byzantine, byzantine_cohort_mask,
+    draw_chaos_plan,
 )
 from fedtorch_tpu.robustness.guards import (  # noqa: F401
     GuardReport, screen_payloads,

@@ -1,0 +1,274 @@
+"""Byzantine-robust aggregation rules for the round/commit programs.
+
+The update guards (guards.py) screen for *benign* damage: non-finite
+leaves and norm explosions. An actual adversary passes both — a
+sign-flipped delta has exactly the honest norm, and a colluding cohort
+can steer the weighted mean anywhere inside the honest spread. These
+rules close that gap at the aggregation seam of
+``parallel/federated.py:_round_core`` (shared by the sync round and the
+async buffered commit, so one implementation defends both planes):
+
+* ``mean`` — the default: the existing weighted sum + renormalization,
+  bitwise-identical to the pre-robustness engine (the rule is static
+  config, so selecting it traces the unchanged program);
+* ``median`` — coordinate-wise median over the accepted updates
+  (Yin et al. 2018, arXiv:1803.01498). Tolerates < 50% byzantine;
+* ``trimmed_mean`` — per coordinate, drop the ``robust_trim_frac``
+  fraction from each end of the sorted accepted values and average the
+  rest (Yin et al. 2018). Tolerates < ``robust_trim_frac`` byzantine;
+* ``krum`` / ``multikrum`` — Blanchard et al. 2017 (arXiv:1703.02757):
+  score each update by the sum of its ``a - f - 2`` smallest pairwise
+  squared distances (``f = floor(robust_trim_frac * a)`` the byzantine
+  budget over ``a`` accepted updates) and keep the best one
+  (``krum``) or the best ``a - f - 2`` (``multikrum``). Selection is a
+  WEIGHT MASK composed into the engine's accept mask, so the guard
+  renormalization path is reused unchanged — the selected clients
+  carry the full round weight;
+* ``norm_bound`` — centered-clipping-style (Karimireddy et al. 2021,
+  arXiv:2012.10333): every accepted update is radially clipped toward
+  the server momentum (the previous commit's unit-scale aggregate,
+  carried in ``server.aux``) with radius ``robust_norm_tau`` x the
+  median distance-to-momentum, then averaged. Bounds what any single
+  client can move the server without discarding anyone.
+
+Scale convention: payloads arrive client-weighted (``w_i * u_i``).
+Statistics are computed on the per-unit-weight updates
+``u_i = payload_i / w_i`` and the robust estimate is rescaled by the
+TOTAL round weight ``W = sum(w)`` — so every rule preserves the round's
+aggregate weight exactly (the property tests/test_robust_agg.py pins
+across random accept masks and staleness weightings), and with all
+updates identical every rule returns exactly the mean path's answer.
+
+Everything is jit-safe (static rule selection, no host syncs, no
+Python branching on traced values) and composes AFTER the chaos/guard
+accept mask and the async staleness weights: ``accept`` already
+excludes crashed and guard-rejected clients, and ``weights`` already
+carry the staleness damping.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from fedtorch_tpu.config import ROBUST_AGGREGATORS, FaultConfig
+from fedtorch_tpu.core.state import tree_where, tree_zeros_like
+from fedtorch_tpu.robustness.guards import (
+    mask_bcast as _bcast, renormalize_accepted,
+)
+
+# stand-in for +inf in distance matrices: large enough to never win an
+# argmin, small enough that summing k of them cannot overflow float32
+_BIG = 1e30
+
+
+class RobustReport(NamedTuple):
+    """What the robust rule did this round (all jit-traced scalars)."""
+    selected: jnp.ndarray  # updates the rule actually aggregated
+    trimmed: jnp.ndarray   # updates excluded/clipped beyond the guards
+
+
+def _is_float(x) -> bool:
+    return jnp.issubdtype(x.dtype, jnp.floating)
+
+
+def _unit_updates(payloads, weights: jnp.ndarray):
+    """Per-unit-weight updates ``u_i = payload_i / w_i`` (zero where
+    ``w_i`` is zero — those clients are out of the candidate set)."""
+    inv = jnp.where(weights > 0.0,
+                    1.0 / jnp.maximum(weights, 1e-30), 0.0)
+    return jax.tree.map(
+        lambda p: p * _bcast(inv, p).astype(p.dtype) if _is_float(p)
+        else p, payloads)
+
+
+def _masked_sum(payloads, mask: jnp.ndarray):
+    """Zero-out-then-sum over the client axis (select, not multiply —
+    0 * NaN is NaN; same rationale as guards.screen_payloads)."""
+    kept = tree_where(mask, payloads, tree_zeros_like(payloads))
+    return jax.tree.map(lambda p: jnp.sum(p, axis=0), kept)
+
+
+def pairwise_sq_dists(unit, cand: jnp.ndarray) -> jnp.ndarray:
+    """[k, k] pairwise squared l2 distances between the float leaves of
+    the stacked unit updates; rows/cols of non-candidates and the
+    diagonal are ``_BIG`` so they can never rank among the closest."""
+    flat = [x.reshape((x.shape[0], -1)).astype(jnp.float32)
+            for x in jax.tree.leaves(unit) if _is_float(x)]
+    X = jnp.concatenate(flat, axis=1)
+    sq = jnp.sum(X * X, axis=1)
+    d = sq[:, None] + sq[None, :] - 2.0 * (X @ X.T)
+    d = jnp.maximum(d, 0.0)  # Gram-trick rounding can dip below zero
+    pair_ok = cand[:, None].astype(bool) & cand[None, :].astype(bool)
+    d = jnp.where(pair_ok, d, _BIG)
+    return jnp.where(jnp.eye(d.shape[0], dtype=bool), _BIG, d)
+
+
+def krum_selection(unit, cand: jnp.ndarray, frac: float,
+                   multi: bool) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(selection mask [k], scores [k]) per Krum/Multi-Krum over the
+    ``a = sum(cand)`` candidates with byzantine budget
+    ``f = floor(frac * a)``: score_i = sum of the ``max(a - f - 2, 1)``
+    smallest distances to other candidates; keep the single best
+    (``krum``) or the best ``max(a - f - 2, 1)`` (``multikrum``).
+    Score ties at the selection boundary keep every tied update (the
+    mask is threshold-based, which stays jit-safe under a traced
+    candidate count)."""
+    k = cand.shape[0]
+    a = jnp.sum(cand)
+    f = jnp.floor(frac * a)
+    closest = jnp.maximum(a - f - 2.0, 1.0)
+    d = pairwise_sq_dists(unit, cand)
+    srt = jnp.sort(d, axis=1)
+    io = jnp.arange(k, dtype=jnp.float32)[None, :]
+    scores = jnp.sum(jnp.where(io < closest, srt, 0.0), axis=1)
+    scores = jnp.where(cand.astype(bool), scores, jnp.inf)
+    n = closest if multi else jnp.asarray(1.0)
+    n = jnp.minimum(n, jnp.maximum(a, 1.0))
+    kth = jnp.take(jnp.sort(scores),
+                   jnp.clip(n.astype(jnp.int32) - 1, 0, k - 1))
+    sel = cand.astype(bool) & (scores <= kth)
+    return sel.astype(jnp.float32), scores
+
+
+def _coordinate_median(unit, candb: jnp.ndarray):
+    """Per-coordinate median over the candidates; float leaves only
+    (non-float wire leaves keep the masked-sum semantics upstream).
+    ``nanmedian`` doubles as the non-finite defense: a poisoned
+    coordinate simply drops out of its median."""
+    def med(u):
+        if not _is_float(u):
+            return None
+        vals = jnp.where(_bcast(candb, u), u.astype(jnp.float32), jnp.nan)
+        m = jnp.nanmedian(vals, axis=0)
+        return jnp.where(jnp.isnan(m), 0.0, m).astype(u.dtype)
+    return med
+
+
+def _trimmed_window(a: jnp.ndarray, frac: float):
+    """(lo, hi, width) of the kept index window inside the sorted
+    candidate block: trim ``t = floor(frac * a)`` from each end,
+    clamped so at least one value survives."""
+    t = jnp.floor(frac * a)
+    t = jnp.minimum(t, jnp.maximum(jnp.floor((a - 1.0) / 2.0), 0.0))
+    lo, hi = t, a - t
+    return lo, hi, jnp.maximum(hi - lo, 1.0)
+
+
+def robust_aggregate(rule: str, payloads, weights: jnp.ndarray,
+                     accept: jnp.ndarray, fault: FaultConfig,
+                     momentum=None):
+    """Aggregate the stacked ``[k, ...]`` payloads under ``rule``.
+
+    ``accept`` is the engine's final {0,1} mask (chaos survivors x
+    guard verdict); ``weights`` the COMPOSED aggregation weights
+    (algorithm base x async staleness). Returns
+    ``(payload_sum, new_momentum, RobustReport)`` where ``payload_sum``
+    is scaled to the full round weight ``sum(weights)`` — the drop-in
+    replacement for the mean path's renormalized sum. ``new_momentum``
+    is None except under ``norm_bound``.
+    """
+    if rule not in ROBUST_AGGREGATORS:
+        raise ValueError(
+            f"unknown robust_agg {rule!r}; expected one of "
+            f"{ROBUST_AGGREGATORS}")
+    k = weights.shape[0]
+    cand = accept * (weights > 0.0).astype(accept.dtype)
+    candb = cand.astype(bool)
+    a = jnp.sum(cand)
+    W = jnp.sum(weights)
+    zero = jnp.zeros(())
+
+    if rule == "mean":
+        payload_sum = _masked_sum(payloads, cand)
+        payload_sum = renormalize_accepted(payload_sum, weights, cand)
+        return payload_sum, None, RobustReport(selected=a, trimmed=zero)
+
+    if rule in ("krum", "multikrum"):
+        unit = _unit_updates(payloads, weights)
+        sel, _ = krum_selection(unit, cand, fault.robust_trim_frac,
+                                multi=rule == "multikrum")
+        payload_sum = _masked_sum(payloads, sel)
+        # the issue with selection rules IS the weight path: the mask
+        # rides the SAME renormalization as crashes/guard rejections,
+        # so the selected clients inherit the full round weight
+        payload_sum = renormalize_accepted(payload_sum, weights, sel)
+        n_sel = jnp.sum(sel)
+        return payload_sum, None, RobustReport(
+            selected=n_sel, trimmed=jnp.maximum(a - n_sel, 0.0))
+
+    unit = _unit_updates(payloads, weights)
+
+    if rule == "median":
+        med = _coordinate_median(unit, candb)
+
+        def agg(u):
+            m = med(u)
+            if m is None:  # non-float wire leaf: masked sum as before
+                return jnp.sum(jnp.where(_bcast(candb, u), u, 0), axis=0)
+            return (m.astype(jnp.float32) * W).astype(u.dtype)
+
+        payload_sum = jax.tree.map(agg, unit)
+        return payload_sum, None, RobustReport(selected=a, trimmed=zero)
+
+    if rule == "trimmed_mean":
+        lo, hi, width = _trimmed_window(a, fault.robust_trim_frac)
+        io = jnp.arange(k, dtype=jnp.float32)
+
+        def agg(u):
+            if not _is_float(u):
+                return jnp.sum(jnp.where(_bcast(candb, u), u, 0), axis=0)
+            # non-candidates sort to the end (+inf), so indices
+            # [0, a) are exactly the candidate block
+            vals = jnp.where(_bcast(candb, u), u.astype(jnp.float32),
+                             jnp.inf)
+            srt = jnp.sort(vals, axis=0)
+            keep = (_bcast(io, u) >= lo) & (_bcast(io, u) < hi)
+            s = jnp.sum(jnp.where(keep, srt, 0.0), axis=0)
+            return (s / width * W).astype(u.dtype)
+
+        payload_sum = jax.tree.map(agg, unit)
+        trimmed = jnp.maximum(a - width, 0.0)
+        return payload_sum, None, RobustReport(
+            selected=width, trimmed=trimmed)
+
+    # norm_bound: radial clip toward the server momentum, then the
+    # standard renormalized weighted mean over the candidates
+    assert rule == "norm_bound"
+    if momentum is None:
+        raise ValueError(
+            "robust_agg='norm_bound' needs the server momentum tree "
+            "(server.aux['norm_bound_m'] — wired by the trainer)")
+    sq = zero
+    for u, m in zip(jax.tree.leaves(unit), jax.tree.leaves(momentum)):
+        if _is_float(u):
+            diff = u.astype(jnp.float32) - m[None].astype(jnp.float32)
+            sq = sq + jnp.sum(jnp.square(diff),
+                              axis=tuple(range(1, diff.ndim)))
+    dist = jnp.sqrt(sq)  # [k] distance to momentum
+    med_d = jnp.nanmedian(jnp.where(candb, dist, jnp.nan))
+    tau = fault.robust_norm_tau * med_d
+    tau = jnp.where(jnp.isnan(tau), 0.0, tau)
+    scale = jnp.minimum(1.0, tau / jnp.maximum(dist, 1e-30))
+
+    def clip(p, m):
+        if not _is_float(p):
+            return p
+        # clipped payload w*(m + (u - m)*s) == p*s + (w*(1-s))*m
+        s = _bcast(scale, p).astype(p.dtype)
+        wm = _bcast(weights * (1.0 - scale), p).astype(p.dtype)
+        return p * s + wm * m[None].astype(p.dtype)
+
+    clipped = jax.tree.map(clip, payloads, momentum)
+    payload_sum = _masked_sum(clipped, cand)
+    payload_sum = renormalize_accepted(payload_sum, weights, cand)
+    # momentum = this commit's unit-scale aggregate (the center the
+    # NEXT round clips toward — "learning from history")
+    inv_w = jnp.where(W > 0.0, 1.0 / jnp.maximum(W, 1e-30), 0.0)
+    new_momentum = jax.tree.map(
+        lambda p, m: (p.astype(jnp.float32) * inv_w).astype(m.dtype)
+        if _is_float(p) else m, payload_sum, momentum)
+    n_clipped = jnp.sum(cand * (scale < 1.0).astype(cand.dtype))
+    return payload_sum, new_momentum, RobustReport(
+        selected=a, trimmed=n_clipped)

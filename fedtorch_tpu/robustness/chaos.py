@@ -25,6 +25,21 @@ Fault semantics (docs/robustness.md):
   corruption, fp overflow, or an adversary). The chaos layer injects it
   at the wire so the server-side guards (guards.py) can be exercised end
   to end.
+* **byzantine** — the client is an ADVERSARY: a FIXED cohort of
+  ``floor(byzantine_rate * num_clients)`` clients (chosen once per run
+  from the run key — persistent adversaries, the Blanchard/Yin threat
+  model) whose uploads are crafted finite vectors designed to steer
+  the server while passing every benign-fault guard (a sign-flipped
+  delta has exactly the honest norm). Modes
+  (``fault.byzantine_mode``): ``sign_flip`` (upload
+  ``-scale * delta``), ``scale`` (norm inflation inside the guard
+  threshold), ``zero`` (free-riding), ``gauss`` (pure noise), and
+  ``collude`` — every byzantine client this round submits the
+  IDENTICAL ``-scale * (honest weighted-mean update)`` (the
+  inner-product manipulation shape: maximally negative alignment with
+  the honest direction, crafted from information only a colluding
+  cohort has). The defense is the robust aggregation layer
+  (robustness/aggregators.py), not the guards.
 """
 from __future__ import annotations
 
@@ -33,7 +48,8 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from fedtorch_tpu.config import FaultConfig
+from fedtorch_tpu.config import BYZANTINE_MODES, FaultConfig
+from fedtorch_tpu.robustness.guards import mask_bcast as _mask_bcast
 
 
 class ChaosPlan(NamedTuple):
@@ -41,13 +57,15 @@ class ChaosPlan(NamedTuple):
     survive: jnp.ndarray       # float {0,1}; 0 = crashed mid-round
     budget_scale: jnp.ndarray  # float (0,1]; <1 = straggler step cut
     nan_inject: jnp.ndarray    # float {0,1}; 1 = upload poisoned to NaN
+    byzantine: jnp.ndarray     # float {0,1}; 1 = adversarial upload
 
 
 def no_chaos_plan(k: int) -> ChaosPlan:
     """The all-healthy plan (faults disabled)."""
     return ChaosPlan(survive=jnp.ones((k,)),
                      budget_scale=jnp.ones((k,)),
-                     nan_inject=jnp.zeros((k,)))
+                     nan_inject=jnp.zeros((k,)),
+                     byzantine=jnp.zeros((k,)))
 
 
 def draw_chaos_plan(rng: jax.Array, k: int, fault: FaultConfig) -> ChaosPlan:
@@ -72,8 +90,11 @@ def draw_chaos_plan(rng: jax.Array, k: int, fault: FaultConfig) -> ChaosPlan:
                       < fault.nan_inject_rate).astype(jnp.float32)
     else:
         nan_inject = jnp.zeros((k,))
+    # byzantine membership is NOT drawn here: adversaries are a FIXED
+    # cohort of the population (byzantine_cohort_mask), not per-round
+    # coin flips — the engine stamps the online slice onto the plan
     return ChaosPlan(survive=survive, budget_scale=budget_scale,
-                     nan_inject=nan_inject)
+                     nan_inject=nan_inject, byzantine=jnp.zeros((k,)))
 
 
 def poison_tree(tree, nan_mask: jnp.ndarray):
@@ -90,3 +111,136 @@ def poison_tree(tree, nan_mask: jnp.ndarray):
             return jnp.where(m, jnp.iinfo(x.dtype).max, x)
         return x
     return jax.tree.map(poison, tree)
+
+
+# fold constants off the chaos key — disjoint from draw_chaos_plan's
+# per-round class folds (0..2). The cohort fold is applied to the RUN
+# key (server.rng, constant across rounds), the noise fold to the
+# per-round chaos key.
+BYZ_NOISE_FOLD = 17
+BYZ_COHORT_FOLD = 19
+
+
+def byzantine_cohort_mask(run_key: jax.Array, num_clients: int,
+                          rate: float) -> jnp.ndarray:
+    """[num_clients] float {0,1} marking the FIXED adversarial cohort:
+    ``floor(rate * num_clients)`` clients chosen once per run from the
+    run key. Byzantine clients are persistent adversaries (the
+    threat-model of Blanchard/Yin/Karimireddy), not per-round coin
+    flips — per-round Bernoulli masks occasionally produce an
+    adversarial MAJORITY at small k, which no robust rule can survive
+    and which no real deployment models. The engine gathers the online
+    slice (``mask[idx]``) onto the round's :class:`ChaosPlan`.
+
+    ``run_key`` must be round-independent (the engine folds
+    ``BYZ_COHORT_FOLD`` off ``server.rng``, which is threaded unchanged
+    through every round), so the cohort is a pure function of the seed.
+    """
+    n = int(rate * num_clients)
+    if n <= 0:
+        return jnp.zeros((num_clients,))
+    u = jax.random.uniform(run_key, (num_clients,))
+    kth = jnp.sort(u)[n - 1]
+    return (u <= kth).astype(jnp.float32)
+
+
+def apply_byzantine(plan: ChaosPlan, deltas, payloads,
+                    weights: jnp.ndarray, rng: jax.Array,
+                    fault: FaultConfig):
+    """Replace the byzantine clients' uploads with crafted vectors.
+
+    Applied at the WIRE, like the nan poison: ``deltas`` (the semantic
+    updates the guards and the robust selection rules judge) and
+    ``payloads`` (the weighted wire contributions, pre
+    ``payload_batch_transform`` so a quantized uplink quantizes the
+    crafted values like any other client's) are transformed in
+    lockstep; the clients' local state stays honest — the adversary
+    controls what it SENDS, not what it trained.
+
+    Deterministic under the threaded PRNG: the mask rides
+    :class:`ChaosPlan` (same threefry chain as every other fault
+    class) and the ``gauss`` mode's noise comes from per-leaf folds of
+    ``rng`` (derived off the chaos key by the engine), so a seeded run
+    replays the identical attack. Float leaves only — integer wire
+    leaves pass through untouched.
+    """
+    mode = fault.byzantine_mode
+    if mode not in BYZANTINE_MODES:
+        raise ValueError(
+            f"unknown byzantine_mode {mode!r}; expected one of "
+            f"{BYZANTINE_MODES}")
+    g = fault.byzantine_scale
+    mask = plan.byzantine
+
+    def is_f(x):
+        return jnp.issubdtype(x.dtype, jnp.floating)
+
+    def swap(tree, crafted):
+        """where(byzantine, crafted_i, honest_i) leafwise."""
+        return jax.tree.map(
+            lambda x, c: jnp.where(_mask_bcast(mask, x).astype(bool),
+                                   c.astype(x.dtype), x)
+            if is_f(x) else x, tree, crafted)
+
+    if mode == "sign_flip":
+        return (swap(deltas, jax.tree.map(lambda d: -g * d, deltas)),
+                swap(payloads, jax.tree.map(lambda p: -g * p, payloads)))
+    if mode == "scale":
+        return (swap(deltas, jax.tree.map(lambda d: g * d, deltas)),
+                swap(payloads, jax.tree.map(lambda p: g * p, payloads)))
+    if mode == "zero":
+        z = jax.tree.map(jnp.zeros_like, deltas)
+        zp = jax.tree.map(jnp.zeros_like, payloads)
+        return swap(deltas, z), swap(payloads, zp)
+    if mode == "gauss":
+        # pure noise at the honest-update scale knob: each byzantine
+        # client draws its own iid stream (leaf index folded so no two
+        # leaves share a draw — lint FTL003's fresh-fold rule). The
+        # payload tree may be structured differently than the delta
+        # tree (control variates, fairness scalars), so it draws its
+        # own disjoint folds and scales by the client weight.
+        def noised(tree, base_fold, weighted):
+            leaves, treedef = jax.tree.flatten(tree)
+            out = []
+            for i, x in enumerate(leaves):
+                if not is_f(x):
+                    out.append(x)
+                    continue
+                n = g * jax.random.normal(
+                    jax.random.fold_in(rng, base_fold + i), x.shape,
+                    jnp.float32)
+                if weighted:
+                    n = n * _mask_bcast(weights, x)
+                out.append(n)
+            return jax.tree.unflatten(treedef, out)
+
+        return (swap(deltas, noised(deltas, 0, weighted=False)),
+                swap(payloads, noised(payloads, 0x1000, weighted=True)))
+
+    # collude: every byzantine client submits the IDENTICAL
+    # -g * (honest weighted-mean update) — crafted from information
+    # only a colluding cohort has, maximally anti-aligned with the
+    # honest direction while each copy carries an honest-sized norm.
+    # The payload-space estimate sum(honest p) / sum(honest w) equals
+    # the delta-space weighted mean exactly for weighted-delta
+    # payloads, so the wire delta the guards judge and the payload the
+    # server aggregates describe the same crafted update.
+    honest = (1.0 - mask) * plan.survive
+    hw = jnp.maximum(jnp.sum(honest * weights), 1e-30)
+
+    def collude_d(x):
+        hm = jnp.sum(x * _mask_bcast(honest * weights, x).astype(x.dtype),
+                     axis=0) / hw.astype(x.dtype)
+        return jnp.broadcast_to(-g * hm[None], x.shape)
+
+    def collude_p(x):
+        hm = jnp.sum(x * _mask_bcast(honest, x).astype(x.dtype),
+                     axis=0) / hw.astype(x.dtype)
+        return _mask_bcast(weights, x).astype(x.dtype) \
+            * jnp.broadcast_to(-g * hm[None], x.shape)
+
+    crafted_d = jax.tree.map(
+        lambda d: collude_d(d) if is_f(d) else d, deltas)
+    crafted_p = jax.tree.map(
+        lambda p: collude_p(p) if is_f(p) else p, payloads)
+    return swap(deltas, crafted_d), swap(payloads, crafted_p)

@@ -39,6 +39,13 @@ class GuardReport(NamedTuple):
     norms: jnp.ndarray     # [k] per-client delta l2 norm (NaN if !finite)
 
 
+def mask_bcast(mask: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
+    """Reshape a [k] per-client vector for broadcasting against a
+    [k, ...] leaf — the one mask-application convention shared by the
+    guards, the chaos layer, and the robust aggregators."""
+    return mask.reshape((-1,) + (1,) * (x.ndim - 1))
+
+
 def renormalize_accepted(payload_sum, weights, accept):
     """Rescale the aggregated payload so the ACCEPTED clients carry the
     full round weight: rejected/crashed weight is redistributed over the
@@ -61,6 +68,19 @@ def renormalize_accepted(payload_sum, weights, accept):
         lambda p: p * renorm.astype(p.dtype)
         if jnp.issubdtype(p.dtype, jnp.floating) else p,
         payload_sum)
+
+
+def all_rejected_scalars(sc: dict) -> bool:
+    """Host-side predicate over the round's fetched scalar dict
+    (``FederatedTrainer.round_host_scalars``): True when the round
+    aggregated NOTHING — every surviving update guard-rejected, or
+    every online client crashed — i.e. the renormalization scale hit 0
+    and the server silently held. Shared by the CLI loop's
+    ``guards.all_rejected`` telemetry event and the supervisor's
+    ``on_all_rejected`` hook, so the two detections cannot drift."""
+    accepted = sc["n_online"] - sc["rejected"]
+    return (sc["n_online"] > 0 and accepted <= 0) \
+        or (sc["n_online"] <= 0 and sc["dropped"] > 0)
 
 
 def client_delta_stats(deltas) -> Tuple[jnp.ndarray, jnp.ndarray]:

@@ -45,6 +45,7 @@ import jax.numpy as jnp
 from fedtorch_tpu import telemetry
 from fedtorch_tpu.config import FaultConfig
 from fedtorch_tpu.core.state import RoundMetrics
+from fedtorch_tpu.robustness.guards import all_rejected_scalars
 from fedtorch_tpu.utils.diagnostics import model_norms
 
 
@@ -71,6 +72,9 @@ class SupervisorStats:
     rollbacks: int = 0
     skipped_rounds: int = 0
     disk_restores: int = 0
+    # rounds where the guards rejected EVERY surviving update (renorm
+    # scale 0 — the server held; see guards.all_rejected_scalars)
+    all_rejected_rounds: int = 0
     last_good_round: int = -1
     loss_ema: Optional[float] = None
 
@@ -93,11 +97,18 @@ class RoundSupervisor:
     def __init__(self, trainer, fault: Optional[FaultConfig] = None,
                  checkpoint_dir: Optional[str] = None,
                  on_degrade: Optional[Callable] = None,
+                 on_all_rejected: Optional[Callable] = None,
                  logger=None, sleep_fn: Callable[[float], None] = time.sleep):
         self.trainer = trainer
         self.fault = fault if fault is not None else trainer.cfg.fault
         self.checkpoint_dir = checkpoint_dir
         self.on_degrade = on_degrade
+        # operator hook for all-rejected rounds (guards rejected every
+        # update — renorm scale 0, the server held). Called as
+        # on_all_rejected(round_idx, scalars) AFTER the round is
+        # otherwise accepted as healthy: a held round is not
+        # divergence, but an operator blind spot if nothing surfaces it
+        self.on_all_rejected = on_all_rejected
         self.logger = logger
         self.sleep_fn = sleep_fn
         self.stats = SupervisorStats()
@@ -211,6 +222,25 @@ class RoundSupervisor:
                 health = self._round_health(out_s, out_c, metrics)
                 if self._healthy(health):
                     self._note_healthy(health)
+                    if (self.fault.guard_updates
+                            or self.fault.chaos_enabled) \
+                            and all_rejected_scalars(self.last_scalars):
+                        self.stats.all_rejected_rounds += 1
+                        telemetry.event("guards.all_rejected",
+                                        round=health["round"] - 1,
+                                        n_online=self.last_scalars[
+                                            "n_online"],
+                                        rejected=self.last_scalars[
+                                            "rejected"],
+                                        dropped=self.last_scalars[
+                                            "dropped"])
+                        self._log(
+                            f"supervisor: round {health['round'] - 1} "
+                            "rejected every update — server held "
+                            "(renorm scale 0)")
+                        if self.on_all_rejected is not None:
+                            self.on_all_rejected(health["round"] - 1,
+                                                 self.last_scalars)
                     return out_s, out_c, metrics
                 self.last_scalars = None  # unhealthy: don't log these
                 why = "non-finite server params or loss blow-up"

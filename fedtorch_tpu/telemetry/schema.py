@@ -44,6 +44,12 @@ METRICS_OPTIONAL = {
     "stragglers": "step-budget cuts (async: delayed dispatches)",
     "rejected": "guard-rejected updates",
     "clipped": "guard-norm-clipped updates",
+    # byzantine adversary + robust aggregation (robustness/chaos.py,
+    # robustness/aggregators.py)
+    "byzantine": "adversary-crafted uploads injected this round",
+    "robust_selected": "updates the robust aggregation rule kept",
+    "robust_trimmed": "updates the robust rule excluded/clipped "
+                      "beyond the guards",
     "staleness": "mean snapshot staleness this commit (async plane)",
     "mean_epoch": "mean training epoch over real clients",
     # per-round host phase wall-clock (seconds)

@@ -142,11 +142,31 @@ def summarize(run_dir: str) -> Dict:
     for ev in run["events"]:
         name = ev.get("event", "?")
         s["events"][name] = s["events"].get(name, 0) + 1
-    # robustness totals (per-round counters summed) + last-row gauges
-    for key in ("dropped", "stragglers", "rejected", "clipped"):
+    # the Robustness section: every guard/chaos/byzantine counter the
+    # rounds recorded (docs/robustness.md threat-model table) — summed
+    # over rounds, plus the rounds each fired in and the attack events.
+    # The legacy total_* event entries derive from the same scan.
+    rob: Dict = {}
+    for key in ("dropped", "stragglers", "rejected", "clipped",
+                "byzantine", "robust_selected", "robust_trimmed"):
         vals = [r[key] for r in rows if key in r]
         if vals and sum(vals):
-            s["events"][f"total_{key}"] = sum(vals)
+            rob[key] = {"total": sum(vals),
+                        "rounds": sum(1 for v in vals if v)}
+    for key in ("dropped", "stragglers", "rejected", "clipped"):
+        if key in rob:
+            s["events"][f"total_{key}"] = rob[key]["total"]
+    for name in ("guards.all_rejected", "chaos.byzantine_attack",
+                 "supervisor.rollback", "supervisor.round_skipped"):
+        if s["events"].get(name):
+            rob.setdefault("events", {})[name] = s["events"][name]
+    for ev in run["events"]:
+        if ev.get("event") == "chaos.byzantine_attack":
+            rob["attack"] = {k: ev[k] for k in
+                             ("mode", "rate", "scale", "robust_agg")
+                             if k in ev}
+            break
+    s["robustness"] = rob
     last = rows[-1]
     for key in sorted(last):
         if key.startswith(("stream_", "async_", "ckpt_", "sup_")):
@@ -185,6 +205,33 @@ def render(run_dir: str) -> str:
         for name, t, share, count in s["phases"]:
             lines.append(f"  {name:<13} {_fmt_s(t):>10}  "
                          f"{share * 100:5.1f}%  ({count} rounds)")
+    rob = s.get("robustness") or {}
+    if rob:
+        lines.append("robustness (chaos/guards/byzantine — summed "
+                     "over rounds):")
+        labels = {
+            "dropped": "chaos-crashed clients",
+            "stragglers": "straggler step cuts / delays",
+            "rejected": "guard-rejected updates",
+            "clipped": "guard-norm-clipped updates",
+            "byzantine": "byzantine uploads injected",
+            "robust_selected": "robust-agg updates kept",
+            "robust_trimmed": "robust-agg updates trimmed",
+        }
+        for key, label in labels.items():
+            if key in rob:
+                c = rob[key]
+                lines.append(f"  {label:<28} {c['total']:g}  "
+                             f"(in {c['rounds']} rounds)")
+        if "attack" in rob:
+            a = rob["attack"]
+            lines.append(
+                "  attack: mode={mode} rate={rate} scale={scale} "
+                "defense=robust_agg:{robust_agg}".format(
+                    **{k: a.get(k, "?") for k in
+                       ("mode", "rate", "scale", "robust_agg")}))
+        for name, n in (rob.get("events") or {}).items():
+            lines.append(f"  event {name:<22} x{n}")
     if s["last_gauges"]:
         lines.append("subsystem gauges (last round):")
         for k, v in s["last_gauges"].items():

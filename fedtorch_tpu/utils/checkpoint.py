@@ -81,6 +81,11 @@ def _compat_meta(cfg: ExperimentConfig) -> dict:
         # sync/async mismatch is a STRUCTURAL incompatibility (it would
         # otherwise surface as a silent corrupt-skip fresh start)
         "sync_mode": cfg.federated.sync_mode,
+        # norm_bound robust aggregation wraps server.aux with its
+        # momentum tree — the same structural-mismatch class. Stored
+        # as a bool (not the rule name) so e.g. mean <-> median resume,
+        # which shares the aux structure, stays legal.
+        "robust_momentum": cfg.fault.robust_agg == "norm_bound",
     }
 
 
@@ -456,11 +461,14 @@ def maybe_resume(directory: Optional[str], server, clients,
                              server, clients)
     old = meta["arguments"]
     new = _compat_meta(cfg)
+    # keys absent from older checkpoints default to the value every
+    # pre-feature run had: all-sync (the only mode that existed) and no
+    # norm_bound momentum wrap
+    legacy_defaults = {"sync_mode": "sync", "robust_momentum": False}
     for key in ("dataset", "batch_size", "arch", "algorithm",
-                "num_clients", "sync_mode"):
-        # pre-async checkpoints carry no sync_mode entry — they are all
-        # sync (the only mode that existed)
-        was = old.get(key, "sync") if key == "sync_mode" else old[key]
+                "num_clients", "sync_mode", "robust_momentum"):
+        was = old.get(key, legacy_defaults[key]) \
+            if key in legacy_defaults else old[key]
         if was != new[key]:
             raise ValueError(
                 f"Checkpoint incompatible: {key} was {was!r}, "

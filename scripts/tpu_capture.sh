@@ -20,6 +20,12 @@
 #                        (sync round clock vs FedBuff-style commit
 #                         clock under the straggler-heavy schedule +
 #                         on-chip ms/commit + accuracy parity)
+#   attack           scripts/chaos_suite.py --attack-matrix
+#                        -> ATTACK_AB.json (byzantine attack x robust
+#                         aggregator grid on the IID pool: 25%
+#                         sign_flip must break plain mean by >5 pts
+#                         while >=1 robust rule holds within 5 —
+#                         docs/robustness.md threat-model table)
 #   telemetry        scripts/telemetry_bench.py   -> TELEMETRY_AB.json
 #                        (off/default/debug overhead A/B on the
 #                         north-star config, <=1% acceptance) +
@@ -63,7 +69,7 @@ TRIES="${TPU_CAPTURE_WAIT_TRIES:-90}"   # ~6 h of patience by default
 # mfu leads: round 6 is the utilization round — the fused-vs-base A/B
 # and the first-ever on-chip traces are the highest-value capture if
 # the relay wedges mid-list
-DEFAULT_STEPS="mfu stream async telemetry bench-streaming \
+DEFAULT_STEPS="mfu stream async attack telemetry bench-streaming \
 bench-dispatch bench-unroll bench zoo pallas flash-train vmap baseline"
 STEPS="${*:-$DEFAULT_STEPS}"
 
@@ -83,6 +89,9 @@ for step in $STEPS; do
         bench-streaming) run env BENCH_STREAMING=1 python bench.py ;;
         stream)         run python scripts/stream_bench.py ;;
         async)          run python scripts/async_bench.py ;;
+        attack)         run python scripts/chaos_suite.py \
+                            --attack-matrix --rounds 25 \
+                            --attack-out ATTACK_AB.json ;;
         telemetry)      run python scripts/telemetry_bench.py \
                             --capture-run artifacts/telemetry_northstar ;;
         conv-ab)        run env BENCH_CONV_IMPL=matmul python bench.py

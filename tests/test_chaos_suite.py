@@ -33,6 +33,23 @@ def test_kill_drill_lifecycle(tmp_path):
 
 
 @pytest.mark.slow
+def test_attack_matrix_acceptance():
+    """ISSUE 9 acceptance: under the fixed 25% sign_flip byzantine
+    cohort (scale 3, guards on — the attack passes them), plain mean
+    must lose > 5 accuracy points (the negative control proving the
+    attack bites) while at least one robust aggregator stays within 5
+    points of fault-free, every cell tracing exactly once."""
+    from chaos_suite import run_attack_matrix
+    report = run_attack_matrix(rounds=12, smoke=True, tol_points=5.0)
+    acc = report["acceptance"]
+    assert acc["attack_bites"]
+    assert acc["defense_holds"]
+    for agg, cell in report["matrix"]["sign_flip"].items():
+        assert cell["byzantine_injected"] > 0, agg
+        assert cell["retraces"] == 0, agg
+
+
+@pytest.mark.slow
 def test_straggler_heavy_async_within_tolerance():
     """ISSUE 6 convergence bar: FedAvg + SCAFFOLD on the async commit
     plane stay within 5 points of the sync plane under the

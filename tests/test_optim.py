@@ -20,6 +20,9 @@ sys.path.insert(0, "/root/reference")
 
 def _torch_sgd(params_np, cfg: OptimConfig):
     import torch
+    pytest.importorskip(
+        "fedtorch",
+        reason="reference checkout not mounted at /root/reference")
     from fedtorch.components.optimizers.sgd import SGD
     tp = [torch.nn.Parameter(torch.tensor(p)) for p in params_np]
     opt = SGD(tp, lr=cfg.lr,

@@ -1,0 +1,542 @@
+"""Byzantine-robust aggregation + in-jit adversary tests (ISSUE 9):
+aggregator unit semantics (outlier resistance, krum selection,
+norm_bound clipping), the byzantine client model (fixed-cohort
+determinism, guard evasion, collusion identity), the total-round-weight
+conservation property across random accept masks x staleness
+weightings, trace-once sentinels over aggregator x plane cells, and the
+``guards.all_rejected`` event/supervisor hook."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from fedtorch_tpu.algorithms import make_algorithm
+from fedtorch_tpu.async_plane.staleness import normalized_staleness_weights
+from fedtorch_tpu.config import (
+    ROBUST_AGGREGATORS, DataConfig, ExperimentConfig, FaultConfig,
+    FederatedConfig, ModelConfig, OptimConfig, TrainConfig,
+)
+from fedtorch_tpu.data import build_federated_data
+from fedtorch_tpu.models import define_model
+from fedtorch_tpu.parallel import FederatedTrainer
+from fedtorch_tpu.robustness.aggregators import (
+    krum_selection, robust_aggregate,
+)
+from fedtorch_tpu.robustness.chaos import (
+    apply_byzantine, byzantine_cohort_mask, no_chaos_plan,
+)
+from fedtorch_tpu.robustness.guards import (
+    all_rejected_scalars, renormalize_accepted,
+)
+
+
+def make_trainer(fault=None, algorithm="fedavg", num_clients=8, rate=1.0,
+                 sync_mode="sync", data_plane="device", local_step=2,
+                 batch_size=16):
+    cfg = ExperimentConfig(
+        data=DataConfig(dataset="synthetic", synthetic_dim=20,
+                        batch_size=batch_size, synthetic_alpha=0.5,
+                        synthetic_beta=0.5, data_plane=data_plane),
+        federated=FederatedConfig(
+            federated=True, num_clients=num_clients, num_comms=20,
+            online_client_rate=rate, algorithm=algorithm,
+            sync_type="local_step", sync_mode=sync_mode),
+        model=ModelConfig(arch="logistic_regression"),
+        optim=OptimConfig(lr=0.1, weight_decay=0.0),
+        train=TrainConfig(local_step=local_step),
+        fault=fault if fault is not None else FaultConfig(),
+    ).finalize()
+    data = build_federated_data(cfg)
+    model = define_model(cfg, batch_size=cfg.data.batch_size)
+    if sync_mode == "async":
+        from fedtorch_tpu.async_plane import AsyncFederatedTrainer
+        return AsyncFederatedTrainer(cfg, model, make_algorithm(cfg),
+                                     data.train)
+    return FederatedTrainer(cfg, model, make_algorithm(cfg), data.train)
+
+
+def _crafted(k=8, dim=5, n_byz=2, scale=-3.0, seed=0):
+    """Honest cluster + byz multiples; returns (payloads, weights,
+    honest_center, byz_mask)."""
+    rng = np.random.RandomState(seed)
+    v = rng.randn(dim).astype(np.float32)
+    deltas = np.tile(v, (k, 1)) + 0.05 * rng.randn(k, dim).astype(
+        np.float32)
+    byz = np.zeros(k, np.float32)
+    for i in range(n_byz):
+        deltas[i] = scale * deltas[i]
+        byz[i] = 1.0
+    w = np.full((k,), 1.0 / k, np.float32)
+    payloads = {"p": jnp.asarray(deltas * w[:, None])}
+    return payloads, jnp.asarray(w), v, byz
+
+
+# -- aggregator unit semantics ----------------------------------------------
+class TestAggregatorUnits:
+    def test_unknown_rule_raises(self):
+        p, w, _, _ = _crafted()
+        with pytest.raises(ValueError, match="robust_agg"):
+            robust_aggregate("geometric", p, w, jnp.ones((8,)),
+                             FaultConfig())
+
+    @pytest.mark.parametrize("rule", ["median", "trimmed_mean", "krum",
+                                      "multikrum"])
+    def test_outlier_resistance(self, rule):
+        """-3x byz multiples swing the mean to ~0 but every robust rule
+        recovers the honest center (scaled by the total weight 1)."""
+        p, w, v, _ = _crafted()
+        flt = FaultConfig(robust_trim_frac=0.3)
+        accept = jnp.ones((8,))
+        mean_out, _, _ = robust_aggregate("mean", p, w, accept, flt)
+        rob_out, _, _ = robust_aggregate(rule, p, w, accept, flt)
+        err_mean = np.linalg.norm(np.asarray(mean_out["p"]) - v)
+        err_rob = np.linalg.norm(np.asarray(rob_out["p"]) - v)
+        assert err_rob < 0.2 * np.linalg.norm(v), (rule, err_rob)
+        assert err_mean > 5 * err_rob
+
+    def test_mean_rule_matches_renormalized_sum(self):
+        p, w, _, _ = _crafted(n_byz=0)
+        accept = jnp.asarray([1.0, 1, 0, 1, 1, 1, 0, 1])
+        out, _, rep = robust_aggregate("mean", p, w, accept,
+                                       FaultConfig())
+        raw = jnp.sum(p["p"] * accept[:, None], axis=0)
+        expect = renormalize_accepted({"p": raw}, w, accept)
+        np.testing.assert_allclose(np.asarray(out["p"]),
+                                   np.asarray(expect["p"]), rtol=1e-6)
+        assert float(rep.selected) == 6.0
+
+    def test_krum_never_selects_byzantine(self):
+        p, w, _, byz = _crafted(k=12, n_byz=3, seed=3)
+        unit = {"p": p["p"] * 12.0}
+        for multi in (False, True):
+            sel, scores = krum_selection(unit, jnp.ones((12,)), 0.3,
+                                         multi)
+            assert float(jnp.sum(sel * jnp.asarray(byz))) == 0.0
+            assert float(jnp.sum(sel)) >= 1.0
+
+    def test_krum_excludes_rejected_candidates(self):
+        p, w, _, _ = _crafted(k=8, n_byz=0)
+        cand = jnp.asarray([0.0, 1, 1, 1, 1, 1, 1, 1])
+        sel, _ = krum_selection({"p": p["p"]}, cand, 0.2, True)
+        assert float(sel[0]) == 0.0
+
+    def test_trimmed_mean_report_counts(self):
+        p, w, _, _ = _crafted(k=10, n_byz=0)
+        flt = FaultConfig(robust_trim_frac=0.2)
+        _, _, rep = robust_aggregate("trimmed_mean", p, w,
+                                     jnp.ones((10,)), flt)
+        # t = floor(0.2 * 10) = 2 per end
+        assert float(rep.trimmed) == 4.0
+        assert float(rep.selected) == 6.0
+
+    def test_trimmed_mean_degenerate_candidates(self):
+        """With 1-2 candidates the trim window clamps instead of
+        trimming everything."""
+        p, w, _, _ = _crafted(k=8, n_byz=0)
+        accept = jnp.zeros((8,)).at[3].set(1.0)
+        flt = FaultConfig(robust_trim_frac=0.4)
+        out, _, rep = robust_aggregate("trimmed_mean", p, w, accept, flt)
+        unit = np.asarray(p["p"][3]) * 8.0  # the sole candidate's unit
+        np.testing.assert_allclose(np.asarray(out["p"]), unit,
+                                   rtol=1e-5)
+        assert float(rep.selected) == 1.0
+
+    def test_norm_bound_clips_and_updates_momentum(self):
+        p, w, v, byz = _crafted(k=8, n_byz=2, scale=-5.0)
+        m0 = {"p": jnp.zeros((5,))}
+        flt = FaultConfig(robust_norm_tau=1.5)
+        out, m1, rep = robust_aggregate("norm_bound", p, w,
+                                        jnp.ones((8,)), flt,
+                                        momentum=m0)
+        # byz at 5x the honest distance must be clipped
+        assert float(rep.trimmed) >= 2.0
+        # aggregate lands nearer the honest center than plain mean
+        mean_out, _, _ = robust_aggregate("mean", p, w, jnp.ones((8,)),
+                                          flt)
+        err_nb = np.linalg.norm(np.asarray(out["p"]) - v)
+        err_mean = np.linalg.norm(np.asarray(mean_out["p"]) - v)
+        assert err_nb < err_mean
+        # new momentum == unit-scale aggregate (W == 1 here)
+        np.testing.assert_allclose(np.asarray(m1["p"]),
+                                   np.asarray(out["p"]), rtol=1e-5)
+
+    def test_norm_bound_requires_momentum(self):
+        p, w, _, _ = _crafted()
+        with pytest.raises(ValueError, match="momentum"):
+            robust_aggregate("norm_bound", p, w, jnp.ones((8,)),
+                             FaultConfig())
+
+    def test_identical_updates_reproduce_mean(self):
+        """With all updates identical every rule returns exactly the
+        mean path's answer — the scale convention pin."""
+        k = 8
+        w = jnp.asarray(np.full((k,), 1.0 / k, np.float32))
+        u = jnp.asarray(np.float32([1.0, -2.0, 0.5]))
+        p = {"p": jnp.tile(u[None], (k, 1)) / k}
+        m0 = {"p": jnp.zeros((3,))}
+        flt = FaultConfig()
+        for rule in ROBUST_AGGREGATORS:
+            out, _, _ = robust_aggregate(
+                rule, p, w, jnp.ones((k,)), flt,
+                momentum=m0 if rule == "norm_bound" else None)
+            np.testing.assert_allclose(np.asarray(out["p"]),
+                                       np.asarray(u), rtol=1e-5,
+                                       err_msg=rule)
+
+
+# -- the weight-conservation property (ISSUE 9 satellite) -------------------
+class TestWeightConservation:
+    """Staleness weighting x guard renormalization x robust-aggregator
+    masks preserves the TOTAL round weight: with every client reporting
+    the same unit update, the aggregate equals sum(composed weights) x
+    that update for every rule, across random accept masks and random
+    staleness draws. The composition under test is exactly the shared
+    ``_round_core`` seam (both the sync round and the async commit
+    funnel through it), with the async half represented by
+    ``normalized_staleness_weights`` composed into the weights —
+    byte-for-byte what ``async_plane/commit.py`` feeds the seam."""
+
+    @pytest.mark.parametrize("rule", list(ROBUST_AGGREGATORS))
+    @pytest.mark.parametrize("trial", [0, 1, 2])
+    def test_total_weight_preserved(self, rule, trial):
+        rng = np.random.RandomState(41 * trial + hash(rule) % 97)
+        k = int(rng.randint(4, 12))
+        base_w = rng.uniform(0.2, 2.0, k).astype(np.float32)
+        stale = rng.randint(0, 6, k).astype(np.float32)
+        mode = ("const", "poly", "inv")[trial % 3]
+        scale = np.asarray(normalized_staleness_weights(
+            jnp.asarray(stale), mode, 0.5))
+        w = jnp.asarray(base_w * scale)
+        accept = np.zeros(k, np.float32)
+        accept[rng.choice(k, size=rng.randint(1, k + 1),
+                          replace=False)] = 1.0
+        u = rng.randn(4).astype(np.float32)
+        payloads = {"p": jnp.asarray(np.outer(np.asarray(w), u))}
+        flt = FaultConfig(robust_trim_frac=0.25)
+        out, _, rep = robust_aggregate(
+            rule, payloads, w, jnp.asarray(accept), flt,
+            momentum={"p": jnp.zeros((4,))} if rule == "norm_bound"
+            else None)
+        W = float(jnp.sum(w))
+        np.testing.assert_allclose(np.asarray(out["p"]), W * u,
+                                   rtol=2e-4, err_msg=f"{rule}/{trial}")
+        assert float(rep.selected) >= 1.0
+
+    def test_masked_selection_renormalizes_to_full_weight(self):
+        """The krum-style mask path through renormalize_accepted: any
+        selection subset carries the full composed weight (the async
+        commit's staleness-damped weights included)."""
+        rng = np.random.RandomState(7)
+        for _ in range(5):
+            k = int(rng.randint(3, 10))
+            w = jnp.asarray(rng.uniform(0.1, 3.0, k).astype(np.float32))
+            sel = np.zeros(k, np.float32)
+            sel[rng.choice(k, size=rng.randint(1, k + 1),
+                           replace=False)] = 1.0
+            payload = {"p": w[:, None] * jnp.ones((k, 3))}
+            masked = {"p": payload["p"] * sel[:, None]}
+            summed = {"p": jnp.sum(masked["p"], axis=0)}
+            out = renormalize_accepted(summed, w, jnp.asarray(sel))
+            np.testing.assert_allclose(
+                np.asarray(out["p"]), float(jnp.sum(w)) * np.ones(3),
+                rtol=1e-5)
+
+
+# -- the byzantine client model ---------------------------------------------
+class TestByzantine:
+    def test_cohort_is_fixed_and_seeded(self):
+        key = jax.random.key(11)
+        a = np.asarray(byzantine_cohort_mask(key, 16, 0.25))
+        b = np.asarray(byzantine_cohort_mask(key, 16, 0.25))
+        np.testing.assert_array_equal(a, b)
+        assert int(a.sum()) == 4
+        c = np.asarray(byzantine_cohort_mask(jax.random.key(12), 16,
+                                             0.25))
+        assert int(c.sum()) == 4
+
+    def test_zero_rate_means_no_cohort(self):
+        m = np.asarray(byzantine_cohort_mask(jax.random.key(0), 16,
+                                             0.0))
+        assert m.sum() == 0
+        # floor: a rate below 1/C selects nobody
+        m = np.asarray(byzantine_cohort_mask(jax.random.key(0), 16,
+                                             0.05))
+        assert m.sum() == 0
+
+    def test_sign_flip_passes_guards_but_counts(self):
+        """The motivating gap: a sign-flipped upload at scale 1 has the
+        honest norm — guards reject NOTHING while the byzantine counter
+        records the attack."""
+        flt = FaultConfig(byzantine_rate=0.25, byzantine_mode="sign_flip",
+                          byzantine_scale=1.0, guard_updates=True)
+        t = make_trainer(fault=flt)
+        s, c = t.init_state(jax.random.key(0))
+        byz = rej = 0.0
+        for _ in range(4):
+            s, c, m = t.run_round(s, c)
+            byz += float(m.byzantine_clients)
+            rej += float(m.rejected_updates)
+        assert byz > 0
+        assert rej == 0.0
+
+    def test_attack_changes_trajectory_and_median_defends(self):
+        """sign_flip x3 must move the server away from the clean
+        trajectory under mean aggregation; coordinate median pulls it
+        back toward clean."""
+        def final_params(fault):
+            t = make_trainer(fault=fault)
+            s, c = t.init_state(jax.random.key(0))
+            for _ in range(5):
+                s, c, _ = t.run_round(s, c)
+            return np.concatenate([np.asarray(x).ravel()
+                                   for x in jax.tree.leaves(s.params)])
+
+        clean = final_params(FaultConfig())
+        atk = dict(byzantine_rate=0.25, byzantine_mode="sign_flip",
+                   byzantine_scale=3.0)
+        attacked_mean = final_params(FaultConfig(**atk))
+        attacked_med = final_params(FaultConfig(robust_agg="median",
+                                                **atk))
+        d_mean = np.linalg.norm(attacked_mean - clean)
+        d_med = np.linalg.norm(attacked_med - clean)
+        assert d_mean > 1e-3  # the attack bites
+        assert d_med < 0.5 * d_mean  # the defense holds
+
+    def test_collude_submits_identical_uploads(self):
+        k, dim = 8, 6
+        rng = np.random.RandomState(0)
+        deltas = {"p": jnp.asarray(rng.randn(k, dim).astype(np.float32))}
+        w = jnp.full((k,), 1.0 / k)
+        payloads = {"p": deltas["p"] / k}
+        plan = no_chaos_plan(k)._replace(
+            byzantine=jnp.asarray([1.0, 1, 0, 0, 0, 0, 0, 0]))
+        flt = FaultConfig(byzantine_rate=0.25, byzantine_mode="collude",
+                          byzantine_scale=2.0)
+        wd, wp = apply_byzantine(plan, deltas, payloads, w,
+                                 jax.random.key(0), flt)
+        wd, wp = np.asarray(wd["p"]), np.asarray(wp["p"])
+        np.testing.assert_array_equal(wd[0], wd[1])  # identical copies
+        honest_mean = np.asarray(deltas["p"])[2:].mean(axis=0)
+        np.testing.assert_allclose(wd[0], -2.0 * honest_mean, rtol=1e-4)
+        # honest uploads untouched
+        np.testing.assert_array_equal(wd[2:], np.asarray(deltas["p"])[2:])
+        # payload carries the weighted crafted update
+        np.testing.assert_allclose(wp[0], -2.0 * honest_mean / k,
+                                   rtol=1e-4)
+
+    def test_zero_scale_gauss_modes(self):
+        k = 6
+        rng = np.random.RandomState(1)
+        deltas = {"p": jnp.asarray(rng.randn(k, 4).astype(np.float32))}
+        w = jnp.full((k,), 0.5)
+        payloads = {"p": deltas["p"] * 0.5}
+        plan = no_chaos_plan(k)._replace(
+            byzantine=jnp.asarray([1.0, 0, 0, 0, 0, 0]))
+        for mode in ("zero", "gauss", "scale"):
+            flt = FaultConfig(byzantine_rate=0.2, byzantine_mode=mode,
+                              byzantine_scale=2.0)
+            wd, wp = apply_byzantine(plan, deltas, payloads, w,
+                                     jax.random.key(3), flt)
+            wd = np.asarray(wd["p"])
+            if mode == "zero":
+                np.testing.assert_array_equal(wd[0], np.zeros(4))
+            elif mode == "scale":
+                np.testing.assert_allclose(
+                    wd[0], 2.0 * np.asarray(deltas["p"])[0], rtol=1e-5)
+            else:
+                assert np.all(np.isfinite(wd[0]))
+                assert not np.allclose(wd[0], np.asarray(deltas["p"])[0])
+            np.testing.assert_array_equal(wd[1:],
+                                          np.asarray(deltas["p"])[1:])
+
+    def test_seeded_replay_is_bit_exact(self):
+        flt = FaultConfig(byzantine_rate=0.25, byzantine_mode="collude",
+                          byzantine_scale=2.0, guard_updates=True,
+                          robust_agg="krum", robust_trim_frac=0.3)
+        outs = []
+        for _ in range(2):
+            t = make_trainer(fault=flt)
+            s, c = t.init_state(jax.random.key(5))
+            for _ in range(3):
+                s, c, m = t.run_round(s, c)
+            outs.append((jax.tree.map(np.asarray, s.params),
+                         float(m.byzantine_clients),
+                         float(m.robust_selected)))
+        for a, b in zip(jax.tree.leaves(outs[0][0]),
+                        jax.tree.leaves(outs[1][0])):
+            np.testing.assert_array_equal(a, b)
+        assert outs[0][1:] == outs[1][1:]
+
+
+# -- config / CLI surface ---------------------------------------------------
+class TestConfigSurface:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="robust_agg"):
+            ExperimentConfig(fault=FaultConfig(
+                robust_agg="geomedian")).finalize()
+        with pytest.raises(ValueError, match="byzantine_mode"):
+            ExperimentConfig(fault=FaultConfig(
+                byzantine_mode="flip")).finalize()
+        with pytest.raises(ValueError, match="robust_trim_frac"):
+            ExperimentConfig(fault=FaultConfig(
+                robust_trim_frac=0.5)).finalize()
+        with pytest.raises(ValueError, match="byzantine_rate"):
+            ExperimentConfig(fault=FaultConfig(
+                byzantine_rate=1.5)).finalize()
+        with pytest.raises(ValueError, match="robust_norm_tau"):
+            ExperimentConfig(fault=FaultConfig(
+                robust_norm_tau=0.0)).finalize()
+
+    def test_norm_bound_gates_structured_payloads(self):
+        with pytest.raises(ValueError, match="norm_bound"):
+            ExperimentConfig(
+                federated=FederatedConfig(federated=True,
+                                          algorithm="scaffold"),
+                fault=FaultConfig(robust_agg="norm_bound"),
+            ).finalize()
+
+    def test_cli_flags_thread_through(self):
+        from fedtorch_tpu.cli import args_to_config, build_parser
+        args = build_parser().parse_args([
+            "--federated", "true", "-d", "synthetic",
+            "--robust_agg", "trimmed_mean", "--robust_trim_frac", "0.3",
+            "--fault_byzantine_rate", "0.25",
+            "--fault_byzantine_mode", "collude",
+            "--fault_byzantine_scale", "2.5",
+        ])
+        cfg = args_to_config(args)
+        assert cfg.fault.robust_agg == "trimmed_mean"
+        assert cfg.fault.robust_trim_frac == 0.3
+        assert cfg.fault.byzantine_rate == 0.25
+        assert cfg.fault.byzantine_mode == "collude"
+        assert cfg.fault.byzantine_scale == 2.5
+
+    def test_chaos_enabled_includes_byzantine(self):
+        assert FaultConfig(byzantine_rate=0.1).chaos_enabled
+        assert not FaultConfig().chaos_enabled
+
+
+# -- norm_bound momentum through state/checkpoint ---------------------------
+class TestNormBoundState:
+    def test_momentum_rides_server_aux(self):
+        flt = FaultConfig(robust_agg="norm_bound")
+        t = make_trainer(fault=flt)
+        s, c = t.init_state(jax.random.key(0))
+        assert set(jax.device_get(s.aux).keys()) == {"alg",
+                                                     "norm_bound_m"}
+        s1, c1, _ = t.run_round(s, c)
+        # the momentum moved off its zero init after one round
+        m1 = jax.device_get(s1.aux["norm_bound_m"])
+        assert any(float(jnp.max(jnp.abs(x))) > 0
+                   for x in jax.tree.leaves(m1))
+
+    def test_resume_across_momentum_structure_refused(self, tmp_path):
+        from fedtorch_tpu.utils.checkpoint import (
+            maybe_resume, save_checkpoint,
+        )
+        flt = FaultConfig(robust_agg="norm_bound")
+        t = make_trainer(fault=flt)
+        s, c = t.init_state(jax.random.key(0))
+        save_checkpoint(str(tmp_path), s, c, t.cfg, 0.0, False)
+        # same rule resumes fine
+        t2 = make_trainer(fault=flt)
+        s2, c2 = t2.init_state(jax.random.key(1))
+        _, _, _, resumed = maybe_resume(str(tmp_path), s2, c2, t2.cfg)
+        assert resumed
+        # a mean-rule config (unwrapped aux) is refused BY NAME
+        t3 = make_trainer()
+        s3, c3 = t3.init_state(jax.random.key(1))
+        with pytest.raises(ValueError, match="robust_momentum"):
+            maybe_resume(str(tmp_path), s3, c3, t3.cfg)
+
+
+# -- trace-once across aggregator x plane cells -----------------------------
+def _run_cell(rule, sync_mode, data_plane, rounds=3):
+    from fedtorch_tpu.utils.tracing import RecompilationSentinel
+    flt = FaultConfig(byzantine_rate=0.25, byzantine_mode="sign_flip",
+                      byzantine_scale=2.0, guard_updates=True,
+                      robust_agg=rule, robust_trim_frac=0.3)
+    t = make_trainer(fault=flt, sync_mode=sync_mode,
+                     data_plane=data_plane, rate=0.5)
+    s, c = t.init_state(jax.random.key(0))
+    s, c, m = t.run_round(s, c)
+    with RecompilationSentinel() as sentinel:
+        for _ in range(rounds - 1):
+            s, c, m = t.run_round(s, c)
+    t.invalidate_stream()
+    assert sum(sentinel.counts.values()) == 0, (
+        f"{rule} x {sync_mode}/{data_plane} retraced")
+    assert all(bool(jnp.all(jnp.isfinite(x)))
+               for x in jax.tree.leaves(s.params))
+    return float(m.robust_selected)
+
+
+class TestTraceOnce:
+    """Every robust aggregator traces exactly once per plane. The fast
+    lane covers (sync, async) x device for two representative rules;
+    the full aggregator x plane matrix (incl. the stream plane) runs in
+    the slow lane."""
+
+    @pytest.mark.parametrize("sync_mode", ["sync", "async"])
+    @pytest.mark.parametrize("rule", ["median", "krum"])
+    def test_device_cells(self, rule, sync_mode):
+        assert _run_cell(rule, sync_mode, "device") >= 1.0
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("sync_mode", ["sync", "async"])
+    @pytest.mark.parametrize("data_plane", ["device", "stream"])
+    @pytest.mark.parametrize("rule", ["median", "trimmed_mean", "krum",
+                                      "multikrum", "norm_bound"])
+    def test_full_matrix(self, rule, sync_mode, data_plane):
+        assert _run_cell(rule, sync_mode, data_plane) >= 1.0
+
+
+# -- all-rejected detection (ISSUE 9 satellite) -----------------------------
+class TestAllRejected:
+    def test_predicate(self):
+        assert all_rejected_scalars(
+            {"n_online": 4.0, "rejected": 4.0, "dropped": 0.0})
+        assert all_rejected_scalars(
+            {"n_online": 0.0, "rejected": 0.0, "dropped": 4.0})
+        assert not all_rejected_scalars(
+            {"n_online": 4.0, "rejected": 3.0, "dropped": 0.0})
+        # the supervisor's zero-metrics skip round must NOT fire it
+        assert not all_rejected_scalars(
+            {"n_online": 0.0, "rejected": 0.0, "dropped": 0.0})
+
+    def test_supervisor_hook_and_event(self, monkeypatch):
+        from fedtorch_tpu import telemetry
+        from fedtorch_tpu.robustness import RoundSupervisor
+        events = []
+        monkeypatch.setattr(
+            telemetry, "event",
+            lambda name, **kw: events.append((name, kw)))
+        import fedtorch_tpu.robustness.supervisor as sup_mod
+        monkeypatch.setattr(
+            sup_mod.telemetry, "event",
+            lambda name, **kw: events.append((name, kw)))
+        hook_calls = []
+        flt = FaultConfig(nan_inject_rate=1.0, guard_updates=True)
+        t = make_trainer(fault=flt)
+        sup = RoundSupervisor(
+            t, sleep_fn=lambda s: None,
+            on_all_rejected=lambda r, sc: hook_calls.append(r))
+        s, c = t.init_state(jax.random.key(0))
+        s, c, m = sup.run_round(s, c)
+        assert float(m.rejected_updates) > 0
+        assert sup.stats.all_rejected_rounds == 1
+        assert hook_calls == [0]
+        names = [n for n, _ in events]
+        assert "guards.all_rejected" in names
+
+    def test_healthy_round_fires_nothing(self):
+        from fedtorch_tpu.robustness import RoundSupervisor
+        hook_calls = []
+        t = make_trainer(fault=FaultConfig(guard_updates=True))
+        sup = RoundSupervisor(
+            t, sleep_fn=lambda s: None,
+            on_all_rejected=lambda r, sc: hook_calls.append(r))
+        s, c = t.init_state(jax.random.key(0))
+        sup.run_round(s, c)
+        assert sup.stats.all_rejected_rounds == 0
+        assert hook_calls == []

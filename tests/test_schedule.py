@@ -17,6 +17,9 @@ sys.path.insert(0, "/root/reference")
 
 def _ref_scheduler(**kw):
     """Build the reference scheduler from a minimal args namespace."""
+    pytest.importorskip(
+        "fedtorch",
+        reason="reference checkout not mounted at /root/reference")
     from fedtorch.components.optimizers.learning import get_lr_scheduler
     args = types.SimpleNamespace(
         lr_schedule_scheme=None, lr_change_epochs=None, lr_fields=None,
@@ -160,6 +163,9 @@ def test_jit_and_scan_evaluable():
 
 class TestSyncScheme:
     def _ref(self, **kw):
+        pytest.importorskip(
+            "fedtorch",
+            reason="reference checkout not mounted at /root/reference")
         from fedtorch.comms.algorithms.distributed import define_sync_freq \
             as ref_fn
         defaults = dict(num_epochs=10, local_step=4,

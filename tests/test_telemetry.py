@@ -425,6 +425,58 @@ class TestRunDirAndReport:
         assert main(["report", run_dir]) == 0
         assert "phase breakdown" in capsys.readouterr().out
 
+    def test_byzantine_run_report_and_events(self, tmp_path):
+        """ISSUE 9: an attacked run lands the one-shot
+        chaos.byzantine_attack event, schema-valid byzantine/robust
+        counters on every row, and a rendered Robustness section."""
+        from fedtorch_tpu.cli import run_experiment
+        from fedtorch_tpu.tools.report import render, summarize
+        run_dir = str(tmp_path / "run")
+        run_experiment(_cli_cfg(
+            run_dir, rounds=3,
+            extra=("--fault_byzantine_rate", "0.5",
+                   "--fault_byzantine_scale", "2.0",
+                   "--robust_agg", "median", "--guard_updates", "true")))
+        rows = [r for r in iter_jsonl(os.path.join(run_dir,
+                                                   "metrics.jsonl"))
+                if "schema" not in r]
+        for r in rows:
+            validate_metrics_row(r)
+        assert sum(r["byzantine"] for r in rows) > 0
+        assert sum(r["robust_selected"] for r in rows) > 0
+        events = [e for e in iter_jsonl(os.path.join(run_dir,
+                                                     "events.jsonl"))
+                  if "schema" not in e]
+        atk = [e for e in events
+               if e["event"] == "chaos.byzantine_attack"]
+        assert len(atk) == 1  # once per run, not per round
+        assert atk[0]["mode"] == "sign_flip"
+        assert atk[0]["robust_agg"] == "median"
+        s = summarize(run_dir)
+        assert s["robustness"]["byzantine"]["total"] > 0
+        assert s["robustness"]["attack"]["robust_agg"] == "median"
+        out = render(run_dir)
+        assert "robustness" in out and "byzantine uploads injected" \
+            in out
+
+    def test_all_rejected_run_emits_event(self, tmp_path):
+        """A round whose every update is guard-rejected (100% NaN
+        injection) emits guards.all_rejected — the renorm-scale-0
+        blind spot this PR closes."""
+        from fedtorch_tpu.cli import run_experiment
+        run_dir = str(tmp_path / "run")
+        run_experiment(_cli_cfg(
+            run_dir, rounds=2,
+            extra=("--fault_nan_inject_rate", "1.0",
+                   "--guard_updates", "true")))
+        events = [e for e in iter_jsonl(os.path.join(run_dir,
+                                                     "events.jsonl"))
+                  if "schema" not in e]
+        rejected = [e for e in events
+                    if e["event"] == "guards.all_rejected"]
+        assert len(rejected) == 2
+        assert rejected[0]["round"] == 0
+
     def test_report_falls_back_to_record0(self, tmp_path):
         # pre-telemetry run dirs (legacy record0 only) stay renderable
         from fedtorch_tpu.tools.report import summarize
