@@ -378,8 +378,14 @@ class AsyncFederatedTrainer(FederatedTrainer):
         (re)start, so supervisor rollback/reseed, checkpoint resume and
         the CLI drain all work unchanged)."""
         if self.data_plane == "stream":
-            self._ensure_async_stream(server)
-            feed, jobs = self._stream.next_feed()
+            def pop():
+                # re-ensures after an invalidate_stream teardown: the
+                # rebuild wrapper's contract is that pop reconstructs
+                # the producer (and the event scheduler with it) from
+                # the live device state
+                self._ensure_async_stream(server)
+                return self._stream.next_feed()
+            feed, jobs = self._pop_stream_with_rebuild(pop)
             return self._commit_stream_jit(server, clients, jobs, feed)
         self._ensure_schedule(server)
         plan = self._sched.next_commit()

@@ -308,6 +308,35 @@ def build_parser() -> argparse.ArgumentParser:
                         "the running loss EMA counts as divergence")
     p.add_argument("--supervisor_max_retries", type=int, default=2)
     p.add_argument("--supervisor_backoff_base", type=float, default=0.5)
+    p.add_argument("--host_fault_seams", default="",
+                   help="comma-separated host-plane fault seams to arm "
+                        "(robustness/host_chaos.py): stream.gather, "
+                        "stream.delay, stream.h2d, ckpt.write, "
+                        "ckpt.torn, telemetry.write, native.load. "
+                        "Faults fire deterministically from "
+                        "--host_fault_seed, so every drill replays "
+                        "(docs/robustness.md 'Host plane')")
+    p.add_argument("--host_fault_rate", type=float, default=0.25,
+                   help="per-check fire probability at each armed "
+                        "host seam")
+    p.add_argument("--host_fault_seed", type=int, default=0,
+                   help="seed of the pure-hash fault schedule")
+    p.add_argument("--host_fault_delay_s", type=float, default=0.02,
+                   help="stall injected per fire at the stream.delay "
+                        "seam (seconds)")
+    p.add_argument("--host_fault_max", type=int, default=0,
+                   help=">0 caps total fires per seam (e.g. "
+                        "host_retry_max+1 at rate 1.0 kills the stream "
+                        "producer exactly once for the rebuild drill); "
+                        "0 = uncapped")
+    p.add_argument("--host_retry_max", type=int, default=3,
+                   help="bounded retry budget at each host seam "
+                        "(stream gather/H2D, checkpoint writes) and "
+                        "the producer-rebuild budget per feed pop "
+                        "(robustness/host_recovery.py)")
+    p.add_argument("--host_retry_backoff_s", type=float, default=0.05,
+                   help="first host-seam retry delay; doubles per "
+                        "attempt (capped at 2s)")
     p.add_argument("--watchdog_timeout_s", type=float, default=0.0,
                    help=">0 arms the stall watchdog: if no round "
                         "completes within this many seconds (a dead "
@@ -498,6 +527,13 @@ def args_to_config(args) -> ExperimentConfig:
             loss_blowup_factor=args.supervisor_loss_blowup,
             max_retries=args.supervisor_max_retries,
             backoff_base_s=args.supervisor_backoff_base,
+            host_fault_seams=args.host_fault_seams,
+            host_fault_rate=args.host_fault_rate,
+            host_fault_seed=args.host_fault_seed,
+            host_fault_delay_s=args.host_fault_delay_s,
+            host_fault_max=args.host_fault_max,
+            host_retry_max=args.host_retry_max,
+            host_retry_backoff_s=args.host_retry_backoff_s,
             watchdog_timeout_s=args.watchdog_timeout_s),
         experiment=args.experiment,
     )
@@ -584,6 +620,31 @@ def run_experiment(cfg: ExperimentConfig,
     tel.install()
     tel.health_update("starting")
 
+    # host-plane chaos + self-healing (docs/robustness.md "Host
+    # plane"): the recovery ledger is ALWAYS installed — real host
+    # faults (a full disk, a gather hiccup) retry and count whether or
+    # not a drill is armed; the seeded injector only when
+    # --host_fault_seams named seams. Both are host-only: no traced
+    # program changes, no device syncs.
+    from fedtorch_tpu.robustness import host_chaos, host_recovery
+    recovery = host_recovery.HostRecovery(
+        policy=host_recovery.RetryPolicy(
+            max_retries=cfg.fault.host_retry_max,
+            backoff_base_s=cfg.fault.host_retry_backoff_s)).install()
+    injector = host_chaos.HostFaultInjector.from_config(cfg.fault)
+    if injector is not None:
+        injector.install()
+        logger.log("host chaos armed: seams="
+                   f"{','.join(sorted(injector.seams))} "
+                   f"rate={injector.rate} seed={injector.seed}")
+
+    def _uninstall_host_plane():
+        # paired with every tel.close(): the active injector/ledger
+        # must not leak past this run into a library caller's next one
+        if injector is not None:
+            injector.uninstall()
+        recovery.uninstall()
+
     # everything from data build through trainer/handler
     # construction can raise (dataset IO, the async/stream
     # gate matrix, resume incompatibility): the active
@@ -615,6 +676,7 @@ def run_experiment(cfg: ExperimentConfig,
                                float(res.top1), float(res.top5))
                 tel.health_update("complete", round_idx=len(history))
             finally:
+                _uninstall_host_plane()
                 tel.close()
             return {"test_top1": float(res.top1), "rounds": len(history)}
 
@@ -647,6 +709,7 @@ def run_experiment(cfg: ExperimentConfig,
             async_ckpt = AsyncCheckpointer()
         saver = async_ckpt.save if async_ckpt is not None else save_checkpoint
         last_saved_round = None
+        lost_at_save = 0
         supervisor = None
         run_round = trainer.run_round
         if cfg.fault.supervisor:
@@ -701,11 +764,13 @@ def run_experiment(cfg: ExperimentConfig,
                   num_comms=cfg.federated.num_comms)
     except BaseException:
         tel.health_update("error")
+        _uninstall_host_plane()
         tel.close()
         raise
     results = {}
     loop_raised = False
     byz_attack_seen = False
+    host_retries_seen = 0
     try:
         for r in range(start_round, cfg.federated.num_comms):
             timer.new_round()
@@ -847,6 +912,11 @@ def run_experiment(cfg: ExperimentConfig,
                           save_all=cfg.checkpoint.save_all_models,
                           save_some_rounds=save_rounds)
                 last_saved_round = r
+                # lost-write watermark at enqueue time: the drain's
+                # skip branch compares against it to detect THIS
+                # round's async write failing behind our back
+                lost_at_save = async_ckpt.lost_writes \
+                    if async_ckpt is not None else 0
                 checkpoint_s = timer.stop("checkpoint")
                 if cfg.federated.personal and fed_data.val is not None \
                         and cfg.effective_algorithm in (
@@ -898,11 +968,29 @@ def run_experiment(cfg: ExperimentConfig,
                            sup_retries=float(supervisor.stats.retries),
                            sup_skipped=float(
                                supervisor.stats.skipped_rounds))
+            # host-plane recovery gauges: retries/recoveries/degraded
+            # seams (and injected-fault count when a drill is armed) —
+            # host counters, zero extra device syncs
+            row.update(recovery.stats())
+            if injector is not None:
+                row.update(injector.stats())
             tel.round_row(row)
             # health: r+1 rounds complete — same convention as
             # checkpoint.json's "round", so monitors can compare the
-            # live counter against the last durable one
-            tel.health_update("running", round_idx=r + 1,
+            # live counter against the last durable one. Intent
+            # reflects the host-plane recovery state: 'degraded' while
+            # any seam runs in degraded mode, 'recovering' on a round
+            # that absorbed a host-seam retry, 'running' otherwise —
+            # the run IS progressing in all three.
+            host_retries_now = recovery.total_retries()
+            if recovery.degraded:
+                intent = "degraded"
+            elif host_retries_now > host_retries_seen:
+                intent = "recovering"
+            else:
+                intent = "running"
+            host_retries_seen = host_retries_now
+            tel.health_update(intent, round_idx=r + 1,
                               staleness=sc["staleness"])
 
             if round_callback is not None:
@@ -921,16 +1009,34 @@ def run_experiment(cfg: ExperimentConfig,
                 tel.event("preempt.drain", round=r,
                           reason=preempt.reason or "peer host")
                 tel.health_update("drain", round_idx=r + 1)
-                if last_saved_round != r:
-                    # skip when this round's eval branch already wrote
-                    # the same state — the snapshot is a collective on
-                    # pods and a preemption deadline is ticking
+                # the resume point the restart depends on must be
+                # DURABLE before exit 75 — a failure here must RAISE,
+                # not be recorded as a lost background write. When
+                # this round's eval branch already saved, drain the
+                # async queue and only redo the (collective-snapshot)
+                # write if that queued write was lost.
+                final_ckpt_needed = last_saved_round != r
+                if not final_ckpt_needed and async_ckpt is not None:
+                    async_ckpt.wait()
+                    final_ckpt_needed = \
+                        async_ckpt.lost_writes > lost_at_save
+                    if final_ckpt_needed:
+                        logger.log("preemption: this round's async "
+                                   "checkpoint was lost — rewriting "
+                                   "synchronously before exit")
+                if final_ckpt_needed:
                     timer.start("checkpoint")
                     with tel.span("checkpoint", round=r, drain=True):
-                        saver(ckpt_dir, server, clients, cfg,
-                              best_prec1, False,
-                              save_all=cfg.checkpoint.save_all_models,
-                              save_some_rounds=save_rounds)
+                        if async_ckpt is not None:
+                            # an older queued write landing AFTER the
+                            # final sync write would roll the resume
+                            # point backwards — drain the queue first
+                            async_ckpt.wait()
+                        save_checkpoint(
+                            ckpt_dir, server, clients, cfg,
+                            best_prec1, False,
+                            save_all=cfg.checkpoint.save_all_models,
+                            save_some_rounds=save_rounds)
                     timer.stop("checkpoint")
                 results["preempted"] = True
                 results["preempted_at_round"] = r
@@ -954,11 +1060,13 @@ def run_experiment(cfg: ExperimentConfig,
             if async_ckpt is not None:
                 # flush pending writes even when the loop raised — the
                 # checkpoint the user would resume from must hit disk.
-                # A flush failure must not MASK the loop's own
-                # exception, but must still raise when the loop
-                # succeeded (sys.exc_info() can't distinguish the two:
-                # it also reports exceptions being handled further up
-                # the call stack).
+                # A background write that failed past its retries was
+                # already recorded (ckpt.degraded event + lost-write
+                # counters; the drain path writes its final checkpoint
+                # synchronously so ITS failure raises at the save) —
+                # close() itself raising is a defensive residue, kept
+                # because it must not MASK the loop's own exception
+                # while still surfacing when the loop succeeded.
                 timer.start("checkpoint")
                 try:
                     async_ckpt.close()
@@ -992,6 +1100,7 @@ def run_experiment(cfg: ExperimentConfig,
                 tel.health_update("preempted")
             else:
                 tel.health_update("complete")
+            _uninstall_host_plane()
             tel.close()
     results["best_top1"] = best_prec1
     if supervisor is not None:
@@ -1007,6 +1116,13 @@ def run_experiment(cfg: ExperimentConfig,
             logger.log(f"supervisor: {st.rollbacks} rollback(s), "
                        f"{st.retries} retrie(s), {st.skipped_rounds} "
                        "skipped round(s)")
+    rec_stats = recovery.stats()
+    if injector is not None:
+        rec_stats.update(injector.stats())
+        rec_stats["host_fault_fires"] = injector.fire_counts()
+    if any(bool(v) for v in rec_stats.values()):
+        results["host_recovery"] = rec_stats
+        logger.log(f"host plane: {rec_stats}")
     results["timer"] = timer.summary()
     logger.log(f"phase timers: {timer.summary()}")
     if results.get("preempted"):

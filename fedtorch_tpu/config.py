@@ -38,6 +38,22 @@ BYZANTINE_MODES = ("sign_flip", "scale", "zero", "gauss", "collude")
 # the momentum can live against, so they raise at construction.
 NORM_BOUND_ALGORITHMS = ("fedavg", "fedprox", "fedadam")
 
+# Named host-plane fault seams (robustness/host_chaos.py;
+# docs/robustness.md "Host plane"). Each names one host-side I/O or
+# thread boundary where the seeded injector can fire — and where the
+# matching self-healing policy (robustness/host_recovery.py) must
+# absorb the fault. Declared here so config validation stays
+# stdlib-only; the injector imports THIS tuple.
+HOST_FAULT_SEAMS = (
+    "stream.gather",    # producer row gather raises (transient)
+    "stream.delay",     # producer gather stalls host_fault_delay_s
+    "stream.h2d",       # device_put dispatch of the packed feed raises
+    "ckpt.write",       # checkpoint atomic write raises ENOSPC
+    "ckpt.torn",        # checkpoint write lands TRUNCATED (torn frame)
+    "telemetry.write",  # metrics/events/health file write raises
+    "native.load",      # native library load fails -> numpy fallback
+)
+
 FEDERATED_ALGORITHMS = (
     "fedavg", "scaffold", "fedprox", "fedgate", "fedadam", "apfl", "afl",
     "perfedavg", "qsparse", "perfedme", "qffl",
@@ -412,6 +428,34 @@ class FaultConfig:
     # retried round draws a fresh participation/chaos schedule (an
     # unchanged deterministic program would reproduce the failure)
     reseed_on_retry: bool = True
+    # -- host-plane fault injection (robustness/host_chaos.py) ---------
+    # comma-separated seam names from HOST_FAULT_SEAMS arming the
+    # deterministic host-fault injector ("" = off). Unlike the in-jit
+    # chaos above, these faults fire on HOST threads and I/O paths —
+    # the stream-feed producer, checkpoint writes, telemetry files,
+    # the native-library loader — and the self-healing layer
+    # (robustness/host_recovery.py) must absorb them: a drill proves
+    # the run completes with a bitwise-identical trajectory, not that
+    # training routes around lost updates.
+    host_fault_seams: str = ""
+    # per-check fire probability at each armed seam. The draw is a
+    # pure hash of (seed, seam, check index), so a drill replays the
+    # exact fault schedule on every run.
+    host_fault_rate: float = 0.25
+    host_fault_seed: int = 0
+    # stall injected at the 'stream.delay' seam (seconds per fire)
+    host_fault_delay_s: float = 0.02
+    # >0 caps the TOTAL fires per seam — e.g. rate=1.0 with a cap of
+    # host_retry_max+1 kills the producer exactly once and lets the
+    # rebuilt producer succeed (the producer-rebuild drill)
+    host_fault_max: int = 0
+    # -- host-plane self-healing (robustness/host_recovery.py) ---------
+    # bounded retry-with-backoff at every host seam (stream gather/H2D,
+    # checkpoint atomic writes) and the producer-rebuild budget: a
+    # failed producer is torn down and rebuilt through the existing
+    # invalidate_stream() resync at most this many times per pop
+    host_retry_max: int = 3
+    host_retry_backoff_s: float = 0.05
     # -- process lifecycle (robustness/preemption.py, watchdog.py) -----
     # > 0 arms the stall watchdog: when no round completes within this
     # many seconds (the signature of a dead peer blocking a DCN
@@ -426,6 +470,18 @@ class FaultConfig:
         return (self.client_drop_rate > 0.0 or self.straggler_rate > 0.0
                 or self.nan_inject_rate > 0.0
                 or self.byzantine_rate > 0.0)
+
+    @property
+    def host_fault_seam_tuple(self) -> tuple:
+        """The armed host seams as a tuple (CLI string split/stripped;
+        empty when host chaos is off)."""
+        return tuple(s.strip() for s in self.host_fault_seams.split(",")
+                     if s.strip())
+
+    @property
+    def host_chaos_enabled(self) -> bool:
+        return bool(self.host_fault_seam_tuple) \
+            and self.host_fault_rate > 0.0
 
 
 @dataclass(frozen=True)
@@ -672,6 +728,32 @@ class ExperimentConfig:
         if flt.max_retries < 0:
             raise ValueError(
                 f"fault.max_retries must be >= 0, got {flt.max_retries}")
+        for seam in flt.host_fault_seam_tuple:
+            if seam not in HOST_FAULT_SEAMS:
+                raise ValueError(
+                    f"fault.host_fault_seams names unknown seam "
+                    f"{seam!r}; expected a comma-separated subset of "
+                    f"{HOST_FAULT_SEAMS}")
+        if not 0.0 <= flt.host_fault_rate <= 1.0:
+            raise ValueError(
+                "fault.host_fault_rate must be in [0, 1], got "
+                f"{flt.host_fault_rate}")
+        if flt.host_fault_delay_s < 0.0:
+            raise ValueError(
+                "fault.host_fault_delay_s must be >= 0, got "
+                f"{flt.host_fault_delay_s}")
+        if flt.host_fault_max < 0:
+            raise ValueError(
+                "fault.host_fault_max must be >= 0 (0 = uncapped), got "
+                f"{flt.host_fault_max}")
+        if flt.host_retry_max < 0:
+            raise ValueError(
+                f"fault.host_retry_max must be >= 0, got "
+                f"{flt.host_retry_max}")
+        if flt.host_retry_backoff_s < 0.0:
+            raise ValueError(
+                "fault.host_retry_backoff_s must be >= 0, got "
+                f"{flt.host_retry_backoff_s}")
         if flt.watchdog_timeout_s < 0.0:
             raise ValueError(
                 "fault.watchdog_timeout_s must be >= 0 (0 = off), got "

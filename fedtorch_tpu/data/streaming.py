@@ -42,6 +42,7 @@ import numpy as np
 from fedtorch_tpu import telemetry
 from fedtorch_tpu.data.batching import ClientData, round_row_plan
 from fedtorch_tpu.native.host_pipeline import HostPrefetcher, gather_rows
+from fedtorch_tpu.robustness import host_chaos, host_recovery
 
 
 class RoundFeed(NamedTuple):
@@ -257,6 +258,29 @@ class StreamFeedProducer:
         self._prefetcher = HostPrefetcher(self._produce, depth=depth,
                                           name="stream-feed-producer")
 
+    def _pack_feed(self, idx, rows) -> RoundFeed:
+        """One gather attempt, with the 'stream.delay'/'stream.gather'
+        host-chaos seams inside the retried closure — each retry
+        re-draws the injector, and a REAL transient gather error (an
+        mmap read hiccup on the ROADMAP-2 disk-backed store) takes the
+        same bounded-retry path. Pure over (idx, rows), so retries are
+        exact replays."""
+        def attempt():
+            host_chaos.maybe_delay("stream.delay")
+            host_chaos.maybe_raise("stream.gather")
+            return self.store.pack(idx, rows, self.batch_size)
+        return host_recovery.retry(attempt, "stream.gather")
+
+    def _place_feed(self, feed, extras):
+        """The device_put dispatch attempt ('stream.h2d' seam):
+        re-placing a host feed is idempotent (another transfer of the
+        same bytes), so a failed dispatch retries bounded too."""
+        def attempt():
+            host_chaos.maybe_raise("stream.h2d")
+            return self._place(feed if extras is None else
+                               (feed, extras))
+        return host_recovery.retry(attempt, "stream.h2d")
+
     def _produce(self, step: int):
         t0 = time.perf_counter()
         with telemetry.span("stream.gather", step=step):
@@ -266,15 +290,14 @@ class StreamFeedProducer:
                 label = self.start_round + step
                 idx, rows = self._schedule(label)
                 extras = None
-            feed = self.store.pack(idx, rows, self.batch_size)
+            feed = self._pack_feed(idx, rows)
         t1 = time.perf_counter()
         # device_put dispatches the H2D copy and returns immediately —
         # the transfer rides behind the in-flight round's compute (so
         # this span is DISPATCH cost; the transfer itself shows up on
         # the device timeline of a profiler capture)
         with telemetry.span("stream.h2d_dispatch", round=label):
-            placed = self._place(feed if extras is None else
-                                 (feed, extras))
+            placed = self._place_feed(feed, extras)
         self.gather_s += t1 - t0
         self.h2d_s += time.perf_counter() - t1
         self.rounds_produced += 1
@@ -287,6 +310,11 @@ class StreamFeedProducer:
                 timeout=self._timeout_s)
         self.wait_s += time.perf_counter() - t0
         if round_idx != self._expected:
+            # close BEFORE raising: the failed run must not leak a
+            # daemon producer thread still filling the queue and
+            # pinning device feed buffers (the consumer is abandoning
+            # this producer — nothing will ever drain it)
+            self.close()
             raise RuntimeError(
                 f"stream feed for round {round_idx} but round "
                 f"{self._expected} expected — the producer desynced "
@@ -294,6 +322,10 @@ class StreamFeedProducer:
                 "invalidate_stream?)")
         self._expected += 1
         return feed
+
+    def alive(self) -> bool:
+        """Producer-thread liveness (the prefetcher's)."""
+        return self._prefetcher.alive()
 
     def stats(self) -> dict:
         """Host gauges for the telemetry round row: prefetch depth at

@@ -13,6 +13,7 @@ import os
 import queue
 import subprocess
 import threading
+import time
 from typing import Optional
 
 import numpy as np
@@ -61,9 +62,25 @@ def _build_library(run=subprocess.run) -> Optional[str]:
             os.unlink(tmp_path)  # a failed compile's partial output
 
 
+def _load_fault_injected() -> bool:
+    """The 'native.load' host-chaos seam: an armed injector forces this
+    load to report failure, driving the caller onto the numpy fallback
+    (bitwise-identical output — the parity tests pin it). Lazy import
+    keeps this module importable with ctypes+numpy alone."""
+    try:
+        from fedtorch_tpu.robustness import host_chaos
+    except ImportError:  # partial install / standalone use
+        return False
+    return host_chaos.fire("native.load")
+
+
 def load_library():
-    """Load (building if needed) the native library; None on failure."""
+    """Load (building if needed) the native library; None on failure
+    (or when the 'native.load' host-fault seam fires — a per-call
+    forced numpy fallback that never poisons the cached handle)."""
     global _lib, _lib_tried
+    if _load_fault_injected():
+        return None
     if _lib is not None or _lib_tried:
         return _lib
     _lib_tried = True
@@ -195,8 +212,14 @@ class HostPrefetcher:
     def __init__(self, produce_fn, depth: int = 2,
                  name: str = "host-prefetcher"):
         self._produce = produce_fn
+        self.name = name
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
+        # the producer's fatal exception, kept BESIDE the queued copy:
+        # the queue delivers it once, but every later next() (a
+        # supervisor retry, a second consumer poll) must still raise
+        # the real error immediately instead of a generic 120s timeout
+        self._error: Optional[BaseException] = None
         self._thread = threading.Thread(target=self._worker, daemon=True,
                                         name=name)
         self._thread.start()
@@ -210,6 +233,7 @@ class HostPrefetcher:
                 self._put(None)
                 return
             except BaseException as e:  # surface producer errors
+                self._error = e
                 self._put(e)
                 return
             if not self._put(item):
@@ -229,10 +253,41 @@ class HostPrefetcher:
         return False
 
     def next(self, timeout: float = 60.0):
-        item = self._q.get(timeout=timeout)
-        if isinstance(item, BaseException):
-            raise item
-        return item
+        """Next produced item, liveness-aware: a DEAD producer raises
+        its stored exception (or a named death report) at the next
+        short poll instead of burning the full ``timeout`` on an empty
+        queue, and a timeout with the thread still ALIVE raises a
+        :class:`TimeoutError` naming the wedged thread — the name to
+        look for in the watchdog's stack dump."""
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                item = self._q.get(timeout=min(
+                    0.2, max(deadline - time.monotonic(), 0.01)))
+            except queue.Empty:
+                if self._error is not None:
+                    raise RuntimeError(
+                        f"{self.name!r} producer thread died: "
+                        f"{self._error!r}") from self._error
+                if not self._thread.is_alive():
+                    raise RuntimeError(
+                        f"{self.name!r} producer thread exited without "
+                        "delivering an item or an error")
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        f"{self.name!r} produced nothing for "
+                        f"{timeout:.0f}s with its thread still alive — "
+                        f"a WEDGED producer; look for thread "
+                        f"{self.name!r} in the watchdog's stack dump")
+                continue
+            if isinstance(item, BaseException):
+                raise item
+            return item
+
+    def alive(self) -> bool:
+        """Producer-thread liveness (False once it exited — normally,
+        after an error, or via close)."""
+        return self._thread.is_alive()
 
     def depth(self) -> int:
         """Items currently buffered (approximate by nature — the worker

@@ -86,6 +86,8 @@ from fedtorch_tpu.parallel.mesh import (
     client_sharding, make_mesh, padded_client_count, replicate,
     replicated_sharding, shard_clients,
 )
+from fedtorch_tpu import telemetry
+from fedtorch_tpu.robustness import host_recovery
 from fedtorch_tpu.robustness.aggregators import robust_aggregate
 from fedtorch_tpu.robustness.chaos import (
     BYZ_COHORT_FOLD, BYZ_NOISE_FOLD, apply_byzantine,
@@ -274,6 +276,10 @@ class FederatedTrainer:
         # _next_stream_feed / invalidate_stream
         self._stream: Optional[StreamFeedProducer] = None
         self._stream_finalizer = None
+        # producer rebuilds survived so far (docs/robustness.md "Host
+        # plane"): a dead producer is torn down and rebuilt through
+        # the invalidate_stream resync instead of aborting the run
+        self._stream_rebuilds = 0
         # trace-event instrumentation (utils.tracing): the sentinel
         # test asserts this program traces exactly once per trainer —
         # "static config => unchanged traced program" is the contract
@@ -1048,6 +1054,8 @@ class FederatedTrainer:
         ss = self.stream_stats()
         if ss is not None:
             out.update(ss)
+        if self.data_plane == "stream":
+            out["stream_rebuilds"] = float(self._stream_rebuilds)
         return out
 
     def staleness_histogram(self) -> Optional[dict]:
@@ -1099,6 +1107,35 @@ class FederatedTrainer:
             self._stream.close()
             self._stream = None
 
+    def _pop_stream_with_rebuild(self, pop: Callable):
+        """Self-healing feed pop (docs/robustness.md "Host plane"):
+        when the producer fails — its thread died on an exhausted
+        gather retry, wedged past ``timeout_s``, or desynced — tear it
+        down and REBUILD it through the :meth:`invalidate_stream`
+        resync instead of aborting the run. The rebuilt producer
+        replays the identical deterministic index schedule from the
+        live device (rng, round), so recovery is exact (bitwise), not
+        approximate. Bounded by ``fault.host_retry_max`` rebuilds per
+        pop; exhaustion raises a seam-named :class:`HostSeamError`
+        the supervisor counts per seam. ``pop`` must (re)construct the
+        producer from live state when none exists — both planes'
+        pops do."""
+        limit = self.cfg.fault.host_retry_max
+        for attempt in range(limit + 1):
+            try:
+                return pop()
+            except Exception as e:
+                self.invalidate_stream()
+                if attempt >= limit:
+                    raise host_recovery.HostSeamError(
+                        "stream.producer",
+                        f"stream feed producer failed {limit + 1} "
+                        f"consecutive pops; last error: {e!r}") from e
+                self._stream_rebuilds += 1
+                host_recovery.get_active().note_retry("stream.producer")
+                telemetry.event("stream.producer_rebuilt",
+                                attempt=attempt + 1, error=repr(e))
+
     # -- host-side round loop ---------------------------------------------
     def run_round(self, server, clients):
         """One communication round. STREAM-PLANE CONTRACT: each call
@@ -1110,8 +1147,9 @@ class FederatedTrainer:
         the replayed (rng, round); the supervisor's retry path and the
         CLI resume path already do this."""
         if self.data_plane == "stream":
-            return self._round_stream_jit(server, clients,
-                                          self._next_stream_feed(server))
+            feed = self._pop_stream_with_rebuild(
+                lambda: self._next_stream_feed(server))
+            return self._round_stream_jit(server, clients, feed)
         return self._round_jit(server, clients, self.data, self.val_data)
 
     def run_rounds(self, server, clients, num_rounds: int):

@@ -11,6 +11,12 @@ from fedtorch_tpu.robustness.guards import (  # noqa: F401
 from fedtorch_tpu.robustness.harness import (  # noqa: F401
     ElasticRunner, read_checkpoint_round,
 )
+from fedtorch_tpu.robustness.host_chaos import (  # noqa: F401
+    HOST_FAULT_SEAMS, HostFaultInjector,
+)
+from fedtorch_tpu.robustness.host_recovery import (  # noqa: F401
+    HostRecovery, HostSeamError, RetryPolicy,
+)
 from fedtorch_tpu.robustness.preemption import (  # noqa: F401
     RESTART_EXIT_CODE, PreemptionHandler,
 )

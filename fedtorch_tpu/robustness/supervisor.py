@@ -75,6 +75,12 @@ class SupervisorStats:
     # rounds where the guards rejected EVERY surviving update (renorm
     # scale 0 — the server held; see guards.all_rejected_scalars)
     all_rejected_rounds: int = 0
+    # host-plane seam failures that escaped their own recovery layer
+    # and reached the supervisor, keyed by seam name (host_recovery
+    # HostSeamError carries the seam) — a repeatedly-failing seam is
+    # an operator signal even when every round eventually retries
+    # through
+    host_seam_failures: dict = dataclasses.field(default_factory=dict)
     last_good_round: int = -1
     loss_ema: Optional[float] = None
 
@@ -98,6 +104,7 @@ class RoundSupervisor:
                  checkpoint_dir: Optional[str] = None,
                  on_degrade: Optional[Callable] = None,
                  on_all_rejected: Optional[Callable] = None,
+                 on_host_fault: Optional[Callable] = None,
                  logger=None, sleep_fn: Callable[[float], None] = time.sleep):
         self.trainer = trainer
         self.fault = fault if fault is not None else trainer.cfg.fault
@@ -109,6 +116,16 @@ class RoundSupervisor:
         # otherwise accepted as healthy: a held round is not
         # divergence, but an operator blind spot if nothing surfaces it
         self.on_all_rejected = on_all_rejected
+        # operator hook for repeated host-plane seam failures: called
+        # as on_host_fault(seam, total_count, exc) whenever a round
+        # attempt raises a seam-named HostSeamError (a host path that
+        # exhausted its OWN retry/rebuild budget); total_count is the
+        # seam's CUMULATIVE failure count this run (the same value
+        # accumulated in stats.host_seam_failures). The supervisor
+        # still rolls back and retries the round; the hook is where an
+        # operator escalates — e.g. switch data_plane, page someone —
+        # when one seam keeps failing
+        self.on_host_fault = on_host_fault
         self.logger = logger
         self.sleep_fn = sleep_fn
         self.stats = SupervisorStats()
@@ -247,6 +264,19 @@ class RoundSupervisor:
             except Exception as e:  # XLA runtime / dispatch failures
                 last_exc = e
                 why = f"round program raised: {e!r}"
+                seam = getattr(e, "seam", None)
+                if seam is not None:
+                    # a host seam failed past its own recovery budget
+                    # (host_recovery.HostSeamError names it): count it
+                    # per seam and give the operator hook a chance to
+                    # escalate before the generic retry below
+                    n = self.stats.host_seam_failures.get(seam, 0) + 1
+                    self.stats.host_seam_failures[seam] = n
+                    telemetry.event("supervisor.host_fault",
+                                    round=round_idx, seam=seam,
+                                    failures=n)
+                    if self.on_host_fault is not None:
+                        self.on_host_fault(seam, n, e)
 
             self.stats.rollbacks += 1
             telemetry.event("supervisor.rollback", round=round_idx,

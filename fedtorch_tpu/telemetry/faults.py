@@ -1,0 +1,51 @@
+"""Seam hooks connecting the telemetry writers to the host-chaos layer.
+
+The telemetry package must stay stdlib-only (the report tool and
+external monitors parse run dirs without a backend), so it cannot
+import ``fedtorch_tpu.robustness`` — whose package init pulls the
+jax-backed chaos/guard modules. This tiny registry inverts the
+dependency: the host-fault injector (``robustness/host_chaos.py``)
+registers a *check* hook here when it installs, and the recovery
+recorder (``robustness/host_recovery.py``) registers a *degrade sink*;
+the writers call :func:`check`/:func:`note_degraded` unconditionally,
+which compile to a None-test when nothing is armed.
+
+* :func:`check` — called inside each writer's try block, so an
+  injected ``OSError`` flows through the SAME error handling a real
+  full disk would exercise (the point of the drill).
+* :func:`note_degraded` — called once when a writer gives up (too many
+  consecutive failures), so the run's degraded-seam set and the
+  ``health.json`` ``degraded`` intent see it.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+_check_hook: Optional[Callable[[str], None]] = None
+_degrade_sink: Optional[Callable[[str], None]] = None
+
+
+def set_check_hook(fn: Optional[Callable[[str], None]]) -> None:
+    """Install (or clear, with None) the fault-injection check hook."""
+    global _check_hook
+    _check_hook = fn
+
+
+def set_degrade_sink(fn: Optional[Callable[[str], None]]) -> None:
+    """Install (or clear, with None) the degraded-seam sink."""
+    global _degrade_sink
+    _degrade_sink = fn
+
+
+def check(seam: str) -> None:
+    """Give an armed injector the chance to raise at ``seam``. Called
+    inside the writer's own try block — injected faults exercise the
+    real recovery path, not a parallel one."""
+    if _check_hook is not None:
+        _check_hook(seam)
+
+
+def note_degraded(seam: str) -> None:
+    """Report that the subsystem owning ``seam`` degraded itself."""
+    if _degrade_sink is not None:
+        _degrade_sink(seam)

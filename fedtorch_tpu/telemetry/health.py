@@ -45,7 +45,8 @@ class HealthFile:
     not raised."""
 
     def __init__(self, path: str, process_index: int = 0,
-                 clock=time.monotonic, min_interval_s: float = 1.0):
+                 clock=time.monotonic, min_interval_s: float = 1.0,
+                 max_consecutive_errors: int = 3, on_degrade=None):
         self.path = path
         self.process_index = process_index
         self.clock = clock
@@ -59,6 +60,15 @@ class HealthFile:
         self.write_errors = 0
         self.writes = 0
         self.throttled = 0
+        # degrade-to-off (docs/robustness.md "Host plane"): after this
+        # many CONSECUTIVE replace failures the writer stops touching
+        # the sick filesystem — a silent health file IS the liveness
+        # signal a dead disk should produce, and per-round write
+        # attempts against it would put its timeouts on the round clock
+        self.max_consecutive_errors = int(max_consecutive_errors)
+        self.degraded = False
+        self._on_degrade = on_degrade
+        self._consecutive_errors = 0
         self._last: Dict = {}
         self._last_write_t: Optional[float] = None
         self._last_progress = clock()
@@ -99,14 +109,29 @@ class HealthFile:
         doc.update(extra)
         self._last = doc
         self._last_write_t = now
+        if self.degraded:
+            return doc  # document kept current in memory; disk is off
         tmp = f"{self.path}.tmp.{os.getpid()}"
         try:
+            from fedtorch_tpu.telemetry import faults
+            faults.check("telemetry.write")
             with open(tmp, "w") as f:
                 json.dump(doc, f)
             os.replace(tmp, self.path)
             self.writes += 1
+            self._consecutive_errors = 0
         except OSError:
             self.write_errors += 1
+            self._consecutive_errors += 1
+            if self._consecutive_errors >= self.max_consecutive_errors:
+                self.degraded = True
+                from fedtorch_tpu.telemetry import faults
+                faults.note_degraded("telemetry.write")
+                if self._on_degrade is not None:
+                    try:
+                        self._on_degrade(self)
+                    except Exception:
+                        pass
         return doc
 
     @property

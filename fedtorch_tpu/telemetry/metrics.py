@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from typing import Dict, List, Optional
 
@@ -24,46 +25,108 @@ from typing import Dict, List, Optional
 class JsonlWriter:
     """Buffered line-per-record appender with a schema header.
 
-    Failure-tolerant like the health file: IO errors are counted and
-    the writer goes inert instead of killing training."""
+    Failure-tolerant like the health file, with a bounded-retry
+    degrade policy (docs/robustness.md "Host plane"): a failed flush
+    KEEPS its buffered rows (bounded) and retries on the next flush —
+    a transient full disk loses nothing — and only after
+    ``max_consecutive_errors`` consecutive failures does the writer
+    degrade to off: buffer dropped, one ``on_degrade`` notification
+    (the hub turns it into a ``telemetry.degraded`` event), and every
+    later write a cheap no-op. Telemetry must never kill training."""
+
+    # rows kept across failed flushes before the oldest are dropped
+    MAX_BUFFER_ROWS = 2000
 
     def __init__(self, path: str, schema: str,
                  run_meta: Optional[Dict] = None,
-                 flush_interval_s: float = 1.0, flush_rows: int = 200):
+                 flush_interval_s: float = 1.0, flush_rows: int = 200,
+                 max_consecutive_errors: int = 3, on_degrade=None):
         self.path = path
         self.schema = schema
         self.rows = 0
         self.write_errors = 0
+        self.dropped_rows = 0
+        self.degraded = False
+        self.max_consecutive_errors = int(max_consecutive_errors)
         self.flush_interval_s = float(flush_interval_s)
         self.flush_rows = int(flush_rows)
+        self._on_degrade = on_degrade
+        self._consecutive_errors = 0
         self._buf: List[str] = []
+        # events arrive from worker threads too (the stream producer's
+        # chaos.host_fault, the checkpoint worker's ckpt.degraded, the
+        # watchdog's firing): buffer append/drain must be mutually
+        # exclusive or a row appended mid-flush is cleared unwritten.
+        # _mutex guards ONLY the buffer (never held across IO);
+        # _open_lock serializes the one-time file open; _io_lock
+        # serializes batch writes (TextIOWrapper is not thread-safe —
+        # concurrent f.write calls can splice lines). The injection
+        # check runs under NONE of them: its first-fire announce
+        # re-enters this writer, and any held lock would self-deadlock.
+        self._mutex = threading.Lock()
+        self._open_lock = threading.Lock()
+        self._io_lock = threading.Lock()
         self._last_flush = time.monotonic()
         self._f = None
         self._header = {"schema": schema,
                         "created_unix": time.time(),
                         **({"run": run_meta} if run_meta else {})}
 
-    def _ensure_open(self):
-        if self._f is not None or self.write_errors:
+    def _open(self):
+        """Open (once) the output file, writing the schema header on a
+        fresh file. Raises ``OSError`` on failure. Guarded by its own
+        lock so two racing first-flushes cannot double-write the
+        header; held only around the open, never around batch IO.
+        NO injection check in here — flush() already checks the seam
+        before calling, and a check under ``_open_lock`` could fire
+        the first-fire announce, which re-enters this writer and would
+        self-deadlock on the held lock."""
+        with self._open_lock:
+            if self._f is None:
+                os.makedirs(os.path.dirname(self.path) or ".",
+                            exist_ok=True)
+                f = open(self.path, "a")
+                if f.tell() == 0:
+                    f.write(json.dumps(self._header) + "\n")
+                    f.flush()
+                self._f = f
             return self._f
-        try:
-            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
-            self._f = open(self.path, "a")
-            if self._f.tell() == 0:
-                self._f.write(json.dumps(self._header) + "\n")
-                self._f.flush()
-        except OSError:
-            self.write_errors += 1
-            self._f = None
-        return self._f
+
+    def _io_error(self) -> None:
+        """Called OUTSIDE ``_mutex``: the degrade notifications can
+        re-enter this (or another) writer via ``telemetry.event``."""
+        self.write_errors += 1
+        self._consecutive_errors += 1
+        if self._consecutive_errors >= self.max_consecutive_errors \
+                and not self.degraded:
+            self.degraded = True
+            with self._mutex:
+                self.dropped_rows += len(self._buf)
+                self._buf.clear()
+            from fedtorch_tpu.telemetry import faults
+            faults.note_degraded("telemetry.write")
+            if self._on_degrade is not None:
+                try:
+                    self._on_degrade(self)
+                except Exception:
+                    pass  # the notification must not outcrash the IO
 
     def write(self, row: Dict, flush: bool = False) -> None:
+        if self.degraded:
+            self.dropped_rows += 1
+            return
         try:
-            self._buf.append(json.dumps(row) + "\n")
+            line = json.dumps(row) + "\n"
         except (TypeError, ValueError):
             self.write_errors += 1
             return
-        self.rows += 1
+        with self._mutex:
+            self._buf.append(line)
+            self.rows += 1
+            if len(self._buf) > self.MAX_BUFFER_ROWS:
+                # a long outage must not grow host memory without bound
+                del self._buf[0]
+                self.dropped_rows += 1
         now = time.monotonic()
         if (flush or len(self._buf) >= self.flush_rows
                 or now - self._last_flush >= self.flush_interval_s):
@@ -71,21 +134,36 @@ class JsonlWriter:
 
     def flush(self) -> None:
         self._last_flush = time.monotonic()
-        if not self._buf:
+        if self.degraded:
             return
-        f = self._ensure_open()
-        if f is None:
-            self._buf.clear()  # inert writer: don't grow forever
-            return
+        # swap the batch out under the lock, do ALL IO (and the
+        # injection check) outside it: a slow disk must not block
+        # every telemetry-emitting thread behind the mutex, and the
+        # injector's first-fire announce re-enters this writer via
+        # telemetry.event — under a held non-reentrant lock that was
+        # a self-deadlock
+        with self._mutex:
+            if not self._buf:
+                return
+            batch, self._buf = self._buf, []
         try:
-            # one write call for the batch: concurrent readers (and a
-            # crash) see whole lines or nothing
-            f.write("".join(self._buf))
-            f.flush()
-            self._buf.clear()
+            from fedtorch_tpu.telemetry import faults
+            faults.check("telemetry.write")
+            with self._io_lock:
+                f = self._open()
+                # one write call for the batch: concurrent readers
+                # (and a crash) see whole lines or nothing
+                f.write("".join(batch))
+                f.flush()
+                self._consecutive_errors = 0
         except OSError:
-            self.write_errors += 1
-            self._buf.clear()
+            # the batch stays buffered for the next attempt (a
+            # transient full disk loses nothing); rows appended by
+            # other threads meanwhile land AFTER — ordering wobble,
+            # never loss
+            with self._mutex:
+                self._buf[0:0] = batch
+            self._io_error()
 
     def close(self) -> None:
         self.flush()

@@ -79,14 +79,18 @@ class Telemetry:
         if not self.enabled:
             return
         self.health = HealthFile(health_path(run_dir, process_index),
-                                 process_index)
+                                 process_index,
+                                 on_degrade=lambda _w:
+                                 self._writer_degraded("health"))
         if self.is_writer:
             self.metrics = JsonlWriter(
                 os.path.join(run_dir, "metrics.jsonl"), METRICS_SCHEMA,
-                run_meta)
+                run_meta,
+                on_degrade=lambda _w: self._writer_degraded("metrics"))
             self.events = JsonlWriter(
                 os.path.join(run_dir, "events.jsonl"), EVENTS_SCHEMA,
-                run_meta)
+                run_meta,
+                on_degrade=lambda _w: self._writer_degraded("events"))
             self.spans = SpanRecorder(max_events=max_span_events)
             self.trace_path = os.path.join(run_dir, "trace.json")
 
@@ -120,6 +124,22 @@ class Telemetry:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+    def _writer_degraded(self, which: str) -> None:
+        """One of the pillar writers gave up (too many consecutive IO
+        failures): emit ONE ``telemetry.degraded`` event — on the
+        events channel if it is still alive (a degraded events writer
+        silently drops it, which is the best that can be done with a
+        dead disk) — and a stderr line so the operator sees it even
+        with every file channel down. The loop keeps running either
+        way: telemetry degrades to off, never to a crash."""
+        import sys
+        try:
+            self.event("telemetry.degraded", writer=which)
+        except Exception:
+            pass
+        print(f"telemetry: {which} writer degraded to off after "
+              "repeated write failures", file=sys.stderr, flush=True)
 
     # -- recording ------------------------------------------------------
     def span(self, name: str, **args):

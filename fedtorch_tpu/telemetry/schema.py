@@ -76,10 +76,25 @@ METRICS_OPTIONAL = {
     "ckpt_writes": "checkpoints durably written so far",
     "ckpt_last_write_s": "serialization+disk wall of the last write",
     "ckpt_total_write_s": "cumulative write wall over the run",
+    # checkpoint degraded mode (docs/robustness.md "Host plane")
+    "ckpt_degraded": "1 once the async writer fell back to sync "
+                     "writes after a lost background write",
+    "ckpt_lost_writes": "background checkpoint writes durably lost "
+                        "(each emitted a ckpt.degraded event)",
     # supervisor (host counters)
     "sup_rollbacks": "supervisor rollbacks so far",
     "sup_retries": "supervisor retries so far",
     "sup_skipped": "supervisor skipped rounds so far",
+    # host-plane chaos + self-healing (robustness/host_chaos.py,
+    # robustness/host_recovery.py; docs/robustness.md "Host plane")
+    "host_faults": "injected host-seam faults fired so far (armed "
+                   "drills only)",
+    "host_retries": "host-seam recovery retries so far (all seams)",
+    "host_recovered": "host operations that succeeded after >= 1 "
+                      "retry",
+    "host_degraded": "host seams currently in degraded mode",
+    "stream_rebuilds": "stream feed producers rebuilt via the "
+                       "invalidate_stream resync after a death",
     # device-side gauges (telemetry.costs.ProgramCostCapture; present
     # once program_costs.json was captured — docs/observability.md
     # "Device-side")
@@ -92,13 +107,15 @@ METRICS_OPTIONAL = {
 }
 
 HEALTH_INTENTS = (
-    "starting",   # process up, loop not yet entered
-    "running",    # making round progress
-    "drain",      # stop agreed; writing the final checkpoint
-    "preempted",  # drained and exiting restartable (75)
-    "stalled",    # watchdog fired; exiting restartable (75)
-    "complete",   # ran to num_comms
-    "error",      # round loop raised
+    "starting",    # process up, loop not yet entered
+    "running",     # making round progress
+    "recovering",  # progressing, but a host seam retried this round
+    "degraded",    # progressing with >= 1 host seam in degraded mode
+    "drain",       # stop agreed; writing the final checkpoint
+    "preempted",   # drained and exiting restartable (75)
+    "stalled",     # watchdog fired; exiting restartable (75)
+    "complete",    # ran to num_comms
+    "error",       # round loop raised
 )
 
 

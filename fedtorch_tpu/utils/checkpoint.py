@@ -149,12 +149,23 @@ def _snapshot(server, clients, cfg: ExperimentConfig):
 # atomic writes, and describes only the latest checkpoint, not the
 # per-round keeps). Legacy unframed checkpoints are still readable.
 _CKPT_MAGIC = b"FTCK1\x00"
-_CKPT_HEADER = len(_CKPT_MAGIC) + 8 + 32
+# frame layout: magic | 8-byte big-endian payload length | sha256 |
+# payload — offsets derived from the magic so every parser (framing,
+# resume verification, the GC quick-probe) reads the same layout
+_CKPT_LEN_OFF = len(_CKPT_MAGIC)
+_CKPT_DIGEST_OFF = _CKPT_LEN_OFF + 8
+_CKPT_HEADER = _CKPT_DIGEST_OFF + 32
 
 
 def _frame_payload(payload: bytes) -> bytes:
     return (_CKPT_MAGIC + len(payload).to_bytes(8, "big")
             + hashlib.sha256(payload).digest() + payload)
+
+
+def _frame_want_len(head: bytes) -> int:
+    """The payload length a frame header claims (``head`` must hold at
+    least ``_CKPT_HEADER`` bytes)."""
+    return int.from_bytes(head[_CKPT_LEN_OFF:_CKPT_DIGEST_OFF], "big")
 
 
 def _unframe_payload(blob: bytes):
@@ -165,8 +176,8 @@ def _unframe_payload(blob: bytes):
         return blob, None
     if len(blob) < _CKPT_HEADER:
         return None, "truncated header"
-    want_len = int.from_bytes(blob[6:14], "big")
-    digest = blob[14:_CKPT_HEADER]
+    want_len = _frame_want_len(blob)
+    digest = blob[_CKPT_DIGEST_OFF:_CKPT_HEADER]
     payload = blob[_CKPT_HEADER:]
     if len(payload) != want_len:
         return None, (f"{len(payload)} payload bytes on disk, expected "
@@ -180,24 +191,77 @@ def _atomic_write(path: str, data: bytes) -> None:
     """tmp + fsync + rename so a crash (including power loss — without
     the fsync, delayed allocation could rename before the data blocks
     hit disk) never corrupts the previous checkpoint. The reference
-    overwrites in place (checkpoint.py:72)."""
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(data)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+    overwrites in place (checkpoint.py:72).
+
+    Self-healing (docs/robustness.md "Host plane"): each write runs
+    under the bounded 'ckpt.write' retry policy — a transient
+    ``OSError`` (ENOSPC racing a log rotation, an NFS hiccup, the
+    injected drill fault) is retried with backoff instead of aborting
+    the run; exhaustion raises a seam-named error."""
+    # lazy imports: utils.__init__ is imported by the robustness
+    # package chain, so a module-level robustness import here would
+    # be circular
+    from fedtorch_tpu.robustness import host_chaos, host_recovery
+
+    def attempt():
+        host_chaos.maybe_raise_io("ckpt.write")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    host_recovery.retry_io(attempt, "ckpt.write")
 
 
 _ROUND_KEEP_RE = re.compile(r"^checkpoint_r(\d+)\.ckpt$")
 
 
+def _frame_probe(path: str):
+    """Tri-state header probe: True = frame (or legacy blob) looks
+    intact, False = CONFIRMED torn (size disagrees with the in-frame
+    length), None = could not read — a transient probe error (the NFS
+    hiccup class the write seams retry) must be treated as "don't
+    know", never as "torn": deleting a keep on a read blip would
+    destroy the very frame the retention exists to protect."""
+    try:
+        size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            head = f.read(_CKPT_HEADER)
+    except OSError:
+        return None
+    if len(head) < len(_CKPT_MAGIC):
+        # shorter than the magic alone: cannot be a valid frame, and
+        # no real legacy msgpack checkpoint is this small either — a
+        # severely torn file must not count against the retention
+        # budget (it would evict the newest restorable frame)
+        return False
+    if not head.startswith(_CKPT_MAGIC):
+        return True  # legacy unframed
+    if len(head) < _CKPT_HEADER:
+        return False
+    return size == _CKPT_HEADER + _frame_want_len(head)
+
+
+def frame_quick_ok(path: str) -> bool:
+    """Cheap integrity check for GC/tests: True only when the frame
+    header verifiably matches the on-disk size (or the file is a
+    legacy unframed blob). Header-only read — no sha256 over the
+    payload, so GC stays O(keeps), not O(bytes); resume still runs
+    the full digest check."""
+    return _frame_probe(path) is True
+
+
 def collect_round_keeps(directory: str, keep_last_n: int) -> list:
     """Bounded retention for the per-round ``checkpoint_r{N}.ckpt``
-    keeps: delete all but the newest ``keep_last_n`` (by round number).
-    ``keep_last_n <= 0`` keeps everything (``save_all_models``'
-    historical semantics); ``checkpoint.ckpt`` / ``model_best.*`` are
-    never candidates. Returns the removed paths."""
+    keeps: retain the newest ``keep_last_n`` VALID frames (by round
+    number) and delete the rest — including torn frames left by a
+    failed/partial write, which never count against the retention
+    budget (a torn newest keep must not evict the newest frame that
+    can actually restore). ``keep_last_n <= 0`` keeps everything
+    (``save_all_models``' historical semantics); ``checkpoint.ckpt`` /
+    ``model_best.*`` are never candidates. Returns the removed
+    paths."""
     if keep_last_n <= 0:
         return []
     keeps = []
@@ -210,8 +274,17 @@ def collect_round_keeps(directory: str, keep_last_n: int) -> list:
         if m:
             keeps.append((int(m.group(1)), name))
     keeps.sort()
+    probes = {name: _frame_probe(os.path.join(directory, name))
+              for _, name in keeps}
+    valid = [name for _, name in keeps if probes[name] is True]
+    retained = set(valid[max(len(valid) - keep_last_n, 0):])
     removed = []
-    for _, name in keeps[:max(len(keeps) - keep_last_n, 0)]:
+    for _, name in keeps:
+        if name in retained or probes[name] is None:
+            # None = the probe could not read the file (transient
+            # error): neither a retention candidate nor deletable —
+            # leave it for a later GC pass to classify
+            continue
         path = os.path.join(directory, name)
         try:
             os.remove(path)
@@ -228,23 +301,28 @@ def _write_checkpoint(directory: str, host_state, meta: dict,
                       keep_last_n: int = 0) -> str:
     """Serialize + write an already-host-resident snapshot (the worker
     half of both the sync and async paths)."""
+    from fedtorch_tpu.robustness import host_chaos  # lazy: see above
     os.makedirs(directory, exist_ok=True)
     # framed payload: resume verifies the in-file length + digest BEFORE
     # trying to deserialize, so a torn/truncated/bit-rotted file is
-    # detected cleanly instead of surfacing as an opaque msgpack error
+    # detected cleanly instead of surfacing as an opaque msgpack error.
+    # The 'ckpt.torn' drill seam truncates individual payload writes
+    # (each file draws independently) but lets the rename land — the
+    # torn frame the integrity record exists to catch at resume/GC time
     payload = _frame_payload(serialization.to_bytes(host_state))
     path = os.path.join(directory, "checkpoint.ckpt")
-    _atomic_write(path, payload)
+    _atomic_write(path, host_chaos.maybe_truncate("ckpt.torn", payload))
     meta_bytes = json.dumps(meta, default=str).encode()
     _atomic_write(os.path.join(directory, "checkpoint.json"), meta_bytes)
     if is_best:
-        _atomic_write(os.path.join(directory, "model_best.ckpt"), payload)
+        _atomic_write(os.path.join(directory, "model_best.ckpt"),
+                      host_chaos.maybe_truncate("ckpt.torn", payload))
         _atomic_write(os.path.join(directory, "model_best.json"),
                       meta_bytes)
     if save_all or round_idx in save_some_rounds:
         _atomic_write(
             os.path.join(directory, f"checkpoint_r{round_idx}.ckpt"),
-            payload)
+            host_chaos.maybe_truncate("ckpt.torn", payload))
         collect_round_keeps(directory, keep_last_n)
     return path
 
@@ -304,6 +382,16 @@ class AsyncCheckpointer:
     durably written — latest-wins dropping would silently lose 'best'
     copies.
 
+    Degraded mode (docs/robustness.md "Host plane"): a background
+    write that still fails after the per-write 'ckpt.write' retries
+    does NOT poison the next :meth:`save` with a confusingly-attributed
+    error (the pre-PR-10 behavior). The checkpointer instead emits one
+    ``ckpt.degraded`` event, counts the lost write, and falls back to
+    SYNCHRONOUS writes — every later ``save`` runs the write on the
+    caller thread, so a persistent disk fault surfaces at the save that
+    actually hit it (and a recovered disk simply keeps checkpointing,
+    slower).
+
     Call :meth:`wait` before reading checkpoints back or at run end.
     :meth:`close` is idempotent, runs on interpreter exit as an
     ``atexit`` fallback (a code path that never reaches the CLI's
@@ -315,13 +403,16 @@ class AsyncCheckpointer:
         import queue
         import threading
         self._q: "queue.Queue" = queue.Queue(maxsize=1)
-        self._errors: list = []
         self._closed = False
         # write-latency/queue gauges for the telemetry round row
         # (docs/observability.md): host counters, read lock-free
         self.writes = 0
         self.last_write_s = 0.0
         self.total_write_s = 0.0
+        # degraded-mode state: flipped by the worker on a write that
+        # exhausted its retries; save() reads it on the caller thread
+        self.degraded = False
+        self.lost_writes = 0
         self._thread = threading.Thread(target=self._worker, daemon=True,
                                         name="async-checkpointer")
         self._thread.start()
@@ -339,53 +430,90 @@ class AsyncCheckpointer:
                 with telemetry.span("checkpoint.write", round=job[4]):
                     _write_checkpoint(*job)
                 self.writes += 1
-            except Exception as e:  # surfaced on the next save()/wait()
-                self._errors.append(e)
+            except Exception as e:
+                self._note_degraded(job[4], e)
             finally:
                 self.last_write_s = time.perf_counter() - t0
                 self.total_write_s += self.last_write_s
                 self._q.task_done()
 
+    def _note_degraded(self, round_idx, exc) -> None:
+        """A write was durably lost: record it once, loudly, and flip
+        to synchronous writes — never poison an unrelated later
+        save()."""
+        import sys
+        self.lost_writes += 1
+        first = not self.degraded
+        self.degraded = True
+        print(f"AsyncCheckpointer: write for round {round_idx} lost "
+              f"after retries ({exc!r}); degrading to synchronous "
+              "checkpoint writes", file=sys.stderr, flush=True)
+        if first:
+            from fedtorch_tpu.robustness import host_recovery
+            host_recovery.get_active().note_degraded("ckpt.write")
+        telemetry.event("ckpt.degraded", round=round_idx,
+                        error=repr(exc), lost_writes=self.lost_writes)
+
     def stats(self) -> dict:
         """Telemetry gauges: durable writes, last/total write wall,
-        and how many snapshots sit queued behind the worker (a rising
-        queue depth means disk is slower than the eval cadence)."""
+        how many snapshots sit queued behind the worker (a rising
+        queue depth means disk is slower than the eval cadence), and
+        the degraded-mode pair."""
         return {
             "ckpt_queue_depth": float(self._q.qsize()),
             "ckpt_writes": float(self.writes),
             "ckpt_last_write_s": self.last_write_s,
             "ckpt_total_write_s": self.total_write_s,
+            "ckpt_degraded": float(self.degraded),
+            "ckpt_lost_writes": float(self.lost_writes),
         }
-
-    def _raise_pending(self):
-        if self._errors:
-            raise RuntimeError(
-                "async checkpoint write failed") from self._errors.pop(0)
 
     def save(self, directory: str, server, clients,
              cfg: ExperimentConfig, best_prec1: float, is_best: bool,
              save_all: bool = False,
              save_some_rounds: Tuple[int, ...] = ()) -> None:
         # the snapshot is a COLLECTIVE on multi-host — all processes
-        # take it FIRST (raising a pending error before it would leave
-        # the other processes blocked inside the allgather: only
-        # process 0 ever has pending write errors); only process 0
-        # enqueues the write
+        # take it FIRST; only process 0 writes
         with telemetry.span("checkpoint.snapshot"):
             host_state = _snapshot(server, clients, cfg)
-        self._raise_pending()
         if not _is_writer_process():
             return
         round_idx = int(server.round)
-        self._q.put((directory, host_state,
-                     _meta_for(cfg, round_idx, best_prec1), is_best,
-                     round_idx, save_all, save_some_rounds,
-                     cfg.checkpoint.keep_last_n))
+        job = (directory, host_state,
+               _meta_for(cfg, round_idx, best_prec1), is_best,
+               round_idx, save_all, save_some_rounds,
+               cfg.checkpoint.keep_last_n)
+        if self.degraded:
+            # synchronous fallback: the write happens HERE, so a
+            # persistent disk fault raises at the save it actually
+            # broke (honest attribution), and a recovered disk keeps
+            # checkpointing without a restart. Drain the worker FIRST:
+            # a job queued before degraded flipped could otherwise
+            # race this thread on the same fixed .tmp names and land
+            # its OLDER round after this newer one
+            self._q.join()
+            from fedtorch_tpu.robustness import host_recovery
+            t0 = time.perf_counter()
+            try:
+                with telemetry.span("checkpoint.write", round=round_idx,
+                                    degraded=True):
+                    # the whole write under the seam retry: dir
+                    # creation can fail with the same transient
+                    # OSErrors the atomic writes can, and exhaustion
+                    # must name the seam either way
+                    host_recovery.retry_io(
+                        lambda: _write_checkpoint(*job), "ckpt.write")
+                self.writes += 1
+            finally:
+                self.last_write_s = time.perf_counter() - t0
+                self.total_write_s += self.last_write_s
+            return
+        self._q.put(job)
 
     def wait(self) -> None:
-        """Block until every enqueued checkpoint is on disk."""
+        """Block until every enqueued checkpoint is on disk (or was
+        recorded lost — see ``degraded``/``lost_writes``)."""
         self._q.join()
-        self._raise_pending()
 
     def close(self) -> None:
         """Drain pending writes and stop the worker. Idempotent: the
@@ -400,8 +528,8 @@ class AsyncCheckpointer:
         try:
             self.wait()
         finally:
-            # shut the worker down even when wait() surfaced a write
-            # error — library users must not leak the thread
+            # shut the worker down even when the drain itself raised —
+            # library users must not leak the thread
             self._q.put(None)
             self._thread.join(timeout=30)
 
@@ -456,9 +584,29 @@ def maybe_resume(directory: Optional[str], server, clients,
             meta = json.load(f)
     except json.JSONDecodeError as e:
         # undecodable content is corruption; a MISSING meta file is an
-        # operator error and propagates as FileNotFoundError above/here
-        return _corrupt_skip(meta_path, f"undecodable meta JSON: {e}",
-                             server, clients)
+        # operator error and propagates as FileNotFoundError above/here.
+        # Default-path self-healing (docs/robustness.md "Host plane"):
+        # a torn checkpoint.json beside a healthy payload must not
+        # discard the run — model_best.json carries the identical
+        # compat `arguments` block, so fall back to it for validation
+        # before giving up. The explicit checkpoint_index path keeps
+        # the strict behavior (the operator pinned a target).
+        meta = None
+        if checkpoint_index is None:
+            try:
+                with open(os.path.join(directory, "model_best.json")) \
+                        as f:
+                    meta = json.load(f)
+                warnings.warn(
+                    f"checkpoint meta at {meta_path} is undecodable "
+                    f"({e}); validated compat against model_best.json "
+                    "instead", RuntimeWarning, stacklevel=2)
+            except (OSError, json.JSONDecodeError):
+                meta = None
+        if meta is None:
+            return _corrupt_skip(meta_path,
+                                 f"undecodable meta JSON: {e}",
+                                 server, clients)
     old = meta["arguments"]
     new = _compat_meta(cfg)
     # keys absent from older checkpoints default to the value every
@@ -481,20 +629,53 @@ def maybe_resume(directory: Optional[str], server, clients,
     C = cfg.federated.num_clients
     with open(path, "rb") as f:
         blob = f.read()
-    # in-file integrity frame first (cheap, precise diagnosis — and
-    # valid for per-round keeps too, since every file carries its own
-    # record); legacy unframed checkpoints fall through to the
-    # deserialization try below
-    payload, why = _unframe_payload(blob)
-    if why is not None:
+    template = {"server": _unkey(server),
+                "clients": _strip_padding(clients, C)}
+
+    def _try_blob(raw):
+        # in-file integrity frame first (cheap, precise diagnosis —
+        # and valid for per-round keeps too, since every file carries
+        # its own record); legacy unframed blobs fall through to the
+        # deserialization try
+        data, bad = _unframe_payload(raw)
+        if bad is not None:
+            return None, bad
+        try:
+            return serialization.from_bytes(template, data), None
+        except Exception as e:  # msgpack/flax raise concrete types
+            return None, f"deserialization failed: {e}"
+
+    restored, why = _try_blob(blob)
+    if restored is None and checkpoint_index is None:
+        # self-healing fallback (docs/robustness.md "Host plane"): the
+        # LATEST checkpoint is torn (a partial write that landed —
+        # ENOSPC mid-replace, the 'ckpt.torn' drill), but older
+        # per-round keeps may still verify. Resume from the newest
+        # valid one rather than silently discarding the whole run —
+        # the compat meta was already validated above, so this is the
+        # same run, just an earlier durable round.
+        keeps = []
+        for name in os.listdir(directory):
+            m = _ROUND_KEEP_RE.match(name)
+            if m:
+                keeps.append((int(m.group(1)), name))
+        for _, name in sorted(keeps, reverse=True):
+            keep_path = os.path.join(directory, name)
+            try:
+                with open(keep_path, "rb") as f:
+                    keep_blob = f.read()
+            except OSError:
+                continue
+            restored, keep_why = _try_blob(keep_blob)
+            if restored is not None:
+                warnings.warn(
+                    f"checkpoint at {path} is corrupt or truncated "
+                    f"({why}); resumed from the newest valid "
+                    f"per-round keep {keep_path} instead",
+                    RuntimeWarning, stacklevel=2)
+                break
+    if restored is None:
         return _corrupt_skip(path, why, server, clients)
-    try:
-        restored = serialization.from_bytes(
-            {"server": _unkey(server),
-             "clients": _strip_padding(clients, C)}, payload)
-    except Exception as e:  # msgpack/flax raise various concrete types
-        return _corrupt_skip(path, f"deserialization failed: {e}",
-                             server, clients)
     # from_bytes hands back numpy arrays that can be zero-copy VIEWS
     # into ``payload``; own them before anything else touches them
     restored = jax.tree.map(_owning_host_copy, restored)

@@ -413,6 +413,290 @@ def run_attack_matrix(rounds: int = 20, smoke: bool = False,
     return report
 
 
+# the host-fault matrix's seam axis IS the config tuple — a new seam
+# landing without a drill cell fails here, not in production
+from fedtorch_tpu.config import HOST_FAULT_SEAMS  # noqa: E402
+
+
+def run_host_fault_matrix(rounds: int = 12, smoke: bool = False,
+                          seed: int = 0, rate: float = 0.25,
+                          seams=None, out_path: str = None) -> dict:
+    """The host-plane chaos drill (ISSUE 10): for every seam in
+    ``HOST_FAULT_SEAMS``, run the REAL CLI loop (``run_experiment`` —
+    telemetry, health, checkpointing, the stream plane) with the
+    seeded injector armed at that seam, and prove:
+
+    * **run-survival** — the run completes every round where the
+      pre-PR behavior was an abort (a producer gather error, an
+      ENOSPC mid-checkpoint, a telemetry write failure);
+    * **exact recovery** — the per-round server-param trajectory is
+      BITWISE-identical to the fault-free baseline (the data path
+      replays a deterministic index schedule, so recovery must be
+      exact, not approximate); the checkpoint seams additionally
+      prove resume-stitching: the newest durable checkpoint restores
+      bitwise against the live final state;
+    * **observability** — >= 1 retry/degraded counter landed on the
+      metrics rows and the seam's events fired (``chaos.host_fault``
+      plus ``host.recovered`` / ``ckpt.degraded`` /
+      ``stream.producer_rebuilt`` where the seam implies them);
+    * **trace discipline** — the round program traces exactly as often
+      as the fault-free run (the sentinel sees no injection-driven
+      retrace).
+
+    One extra cell, ``stream.rebuild``, drives the gather seam at rate
+    1.0 with a fire cap of ``host_retry_max + 1``: the producer's own
+    retries exhaust, the thread DIES, the consumer reports it
+    promptly with the seam named, and the trainer rebuilds the
+    producer through the ``invalidate_stream`` resync — the
+    run-recovers-instead-of-aborting bar.
+
+    Injection is a pure hash of (seed, seam, check index), so the
+    whole matrix is replayable; results land in HOST_CHAOS_AB.json.
+    """
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import hashlib
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from fedtorch_tpu.cli import run_experiment
+    from fedtorch_tpu.config import (
+        CheckpointConfig, DataConfig, ExperimentConfig, FaultConfig,
+        FederatedConfig, ModelConfig, OptimConfig, TelemetryConfig,
+        TrainConfig,
+    )
+    from fedtorch_tpu.telemetry import iter_jsonl
+    from fedtorch_tpu.utils.tracing import RecompilationSentinel
+
+    seams = tuple(seams) if seams else HOST_FAULT_SEAMS + (
+        "stream.rebuild",)
+    C = 6 if smoke else 10
+    B = 8 if smoke else 16
+    K = 2
+    rounds = max(rounds, 6)
+    root = tempfile.mkdtemp(prefix="host_chaos_")
+
+    def cell_cfg(run_dir: str, fault: FaultConfig,
+                 save_all: bool = False) -> ExperimentConfig:
+        return ExperimentConfig(
+            data=DataConfig(dataset="synthetic", synthetic_dim=20,
+                            batch_size=B, data_plane="stream"),
+            federated=FederatedConfig(
+                federated=True, num_clients=C, num_comms=rounds,
+                online_client_rate=0.5, algorithm="fedavg",
+                sync_type="local_step"),
+            model=ModelConfig(arch="logistic_regression"),
+            optim=OptimConfig(lr=0.5, weight_decay=0.0),
+            # eval (and therefore a checkpoint write) every round: the
+            # ckpt seams need real write traffic to bite
+            train=TrainConfig(local_step=K, eval_freq=1),
+            # save_all (the torn cell): per-round keeps give the
+            # torn-main-checkpoint resume fallback something to stitch
+            # from
+            checkpoint=CheckpointConfig(run_dir=run_dir,
+                                        async_save=True,
+                                        save_all_models=save_all),
+            telemetry=TelemetryConfig(level="default"),
+            fault=fault,
+        ).finalize()
+
+    def fingerprint(leaves) -> str:
+        h = hashlib.sha256()
+        for leaf in leaves:
+            h.update(np.ascontiguousarray(leaf).tobytes())
+        return h.hexdigest()
+
+    def one_run(name: str, fault: FaultConfig, save_all: bool = False):
+        """One CLI run; returns (per-round param fingerprints,
+        results, run_dir, trace count)."""
+        run_dir = os.path.join(root, name.replace(".", "_"))
+        fingerprints = []
+
+        def cb(r, trainer, server, clients, metrics):
+            fingerprints.append(fingerprint(
+                jax.device_get(jax.tree.leaves(server.params))))
+
+        cfg = cell_cfg(run_dir, fault, save_all)
+        with RecompilationSentinel() as sentinel:
+            results = run_experiment(cfg, round_callback=cb)
+        return fingerprints, results, run_dir, dict(sentinel.counts)
+
+    def read_rows(run_dir):
+        path = os.path.join(run_dir, "metrics.jsonl")
+        if not os.path.exists(path):
+            return []
+        return [r for r in iter_jsonl(path) if "round" in r]
+
+    def read_events(run_dir):
+        path = os.path.join(run_dir, "events.jsonl")
+        if not os.path.exists(path):
+            return []
+        return [r for r in iter_jsonl(path) if "event" in r]
+
+    log(f"host-fault matrix: baseline ({rounds} rounds, C={C})")
+    base_fps, base_res, base_dir, base_traces = one_run(
+        "baseline", FaultConfig())
+    assert len(base_fps) == rounds, "baseline did not complete"
+
+    report = {"rounds": rounds, "clients": C, "rate": rate,
+              "seed": seed, "baseline_traces": base_traces,
+              "matrix": {}}
+    t0 = time.time()
+    for seam in seams:
+        if seam == "stream.rebuild":
+            # rate 1.0 + a fire cap of retries+1: the producer's own
+            # gather retries exhaust exactly once, the thread dies,
+            # and the trainer must rebuild it
+            retry_max = FaultConfig().host_retry_max
+            fault = FaultConfig(host_fault_seams="stream.gather",
+                                host_fault_rate=1.0,
+                                host_fault_seed=seed,
+                                host_fault_max=retry_max + 1,
+                                host_retry_backoff_s=0.0)
+        else:
+            fault = FaultConfig(host_fault_seams=seam,
+                                host_fault_rate=rate,
+                                host_fault_seed=seed,
+                                host_retry_backoff_s=0.0)
+        fps, results, run_dir, traces = one_run(
+            seam, fault, save_all=seam == "ckpt.torn")
+
+        # run-survival + bitwise trajectory (the stream plane replays
+        # a deterministic schedule; recovery must be exact)
+        assert len(fps) == rounds, \
+            f"{seam}: faulted run aborted at round {len(fps)}"
+        assert not results.get("preempted"), f"{seam}: run preempted"
+        assert fps == base_fps, (
+            f"{seam}: recovered trajectory diverged from the "
+            "fault-free run (first mismatch at round "
+            f"{[a == b for a, b in zip(base_fps, fps)].index(False)})")
+        # trace-once with injection armed: the streamed round program
+        # traced exactly once and NOTHING retraced (evaluate.run etc.
+        # trace at most once per process — the baseline pays those)
+        round_prog = "federated.round_stream[fedavg]"
+        assert traces.get(round_prog) == 1, (
+            f"{seam}: {round_prog} traced {traces.get(round_prog)}x "
+            f"(trace-once bar); all counts: {traces}")
+        assert all(v == 1 for v in traces.values()), (
+            f"{seam}: a program retraced mid-run: {traces}")
+
+        rows = read_rows(run_dir)
+        events = read_events(run_dir)
+        names = [e["event"] for e in events]
+        last = rows[-1] if rows else {}
+        fired = int(last.get("host_faults", 0))
+        retries = int(last.get("host_retries", 0))
+        recovered = int(last.get("host_recovered", 0))
+        degraded = int(last.get("host_degraded", 0))
+        rebuilds = int(last.get("stream_rebuilds", 0))
+        entry = {
+            "host_faults": fired, "host_retries": retries,
+            "host_recovered": recovered, "host_degraded": degraded,
+            "stream_rebuilds": rebuilds, "traces": traces,
+            "bitwise_identical": True,
+            "events": sorted(set(names) - {"run.start", "run.end"}),
+        }
+
+        # telemetry.write can degrade the metrics writer itself — the
+        # injector fired even when the last row could not land; the
+        # run dir's un-dropped rows/events still prove the drill
+        if seam == "telemetry.write":
+            assert fired >= 1 or "chaos.host_fault" in names or \
+                degraded >= 1 or not rows, \
+                f"{seam}: no observable injection"
+        else:
+            assert fired >= 1, f"{seam}: injector never fired " \
+                f"(rows={bool(rows)})"
+            assert "chaos.host_fault" in names, \
+                f"{seam}: chaos.host_fault event missing"
+        if seam in ("stream.gather", "stream.h2d", "ckpt.write"):
+            assert retries >= 1, f"{seam}: no recovery retry counted"
+            assert recovered >= 1 or degraded >= 1, \
+                f"{seam}: neither recovered nor degraded"
+            assert "host.recovered" in names \
+                or "host.degraded" in names, \
+                f"{seam}: no recovery/degrade event"
+        if seam == "stream.rebuild":
+            assert rebuilds >= 1, \
+                "producer death did not trigger a rebuild"
+            assert "stream.producer_rebuilt" in names, \
+                "stream.producer_rebuilt event missing"
+            rebuilt = [e for e in events
+                       if e["event"] == "stream.producer_rebuilt"]
+            assert any("stream.gather" in e.get("error", "")
+                       for e in rebuilt), (
+                "the rebuild event does not name the failing seam: "
+                f"{rebuilt}")
+
+        # checkpoint seams: resume-stitched identity — the newest
+        # durable checkpoint (or, for the torn seam, the newest VALID
+        # frame the resume fallback found) must restore BITWISE
+        # against the live state it snapshotted at that round
+        if seam in ("ckpt.write", "ckpt.torn"):
+            entry["resume"] = _check_resume_stitch(
+                cell_cfg(run_dir, fault), run_dir, fps, fingerprint,
+                rounds, require_final=seam == "ckpt.write")
+        report["matrix"][seam] = entry
+        log(f"host-fault {seam}: faults={fired} retries={retries} "
+            f"recovered={recovered} degraded={degraded} "
+            f"rebuilds={rebuilds} bitwise=ok events={entry['events']}")
+
+    report["wall_seconds"] = round(time.time() - t0, 1)
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+        log(f"host-fault matrix written to {out_path}")
+    return report
+
+
+def _check_resume_stitch(cfg, run_dir: str, fps, fingerprint,
+                         rounds: int, require_final: bool):
+    """Resume from the faulted run's directory into a fresh trainer:
+    the restored params must BITWISE match the live trajectory at the
+    restored round. ``require_final`` (the ENOSPC seam, where per-write
+    retry absorbs every fault) additionally demands the FINAL round —
+    the torn seam may legitimately stitch from an earlier round when
+    the last ``checkpoint.ckpt`` write landed torn and the resume
+    fallback picked the newest valid per-round keep."""
+    import warnings as _warnings
+
+    import jax
+
+    from fedtorch_tpu.algorithms import make_algorithm
+    from fedtorch_tpu.data import build_federated_data
+    from fedtorch_tpu.models import define_model
+    from fedtorch_tpu.parallel import FederatedTrainer
+    from fedtorch_tpu.utils.checkpoint import maybe_resume
+
+    data = build_federated_data(cfg)
+    model = define_model(cfg, batch_size=cfg.data.batch_size)
+    trainer = FederatedTrainer(cfg, model, make_algorithm(cfg),
+                               data.train)
+    server, clients = trainer.init_state(
+        jax.random.key(cfg.train.manual_seed))
+    with _warnings.catch_warnings():
+        # the torn seam's fallback warns by design
+        _warnings.simplefilter("ignore", RuntimeWarning)
+        server, clients, _, resumed = maybe_resume(
+            run_dir, server, clients, cfg)
+    assert resumed, "no durable checkpoint survived the ckpt drill"
+    resumed_round = int(jax.device_get(server.round))
+    assert 1 <= resumed_round <= rounds, resumed_round
+    if require_final:
+        assert resumed_round == rounds, \
+            f"retried writes still lost rounds ({resumed_round})"
+    restored_fp = fingerprint(
+        jax.device_get(jax.tree.leaves(server.params)))
+    assert restored_fp == fps[resumed_round - 1], (
+        f"restored round {resumed_round} params do not match the live "
+        "trajectory (resume-stitch not bitwise)")
+    trainer.invalidate_stream()
+    return {"resumed_round": resumed_round, "bitwise": True}
+
+
 def run_kill_drill(rounds: int = 150, ckpt_root: str = None) -> dict:
     """Process-lifecycle chaos (ISSUE 4): SIGTERM the REAL CLI mid-run,
     assert it drains and exits 75, then let the ElasticRunner harness
@@ -505,7 +789,28 @@ def main():
                          "--attack-out")
     ap.add_argument("--attack-out", default="ATTACK_AB.json",
                     help="output path for the attack-matrix report")
+    ap.add_argument("--host-fault-matrix", action="store_true",
+                    help="run the host-plane fault drill instead: one "
+                         "real CLI run per HOST_FAULT_SEAMS seam with "
+                         "the seeded injector armed, asserting "
+                         "run-survival, bitwise-identical recovery, "
+                         "resume-stitched checkpoints, fired "
+                         "retry/degraded counters+events and no "
+                         "injection-driven retrace; writes --host-out "
+                         "(docs/robustness.md 'Host plane')")
+    ap.add_argument("--host-out", default="HOST_CHAOS_AB.json",
+                    help="output path for the host-fault-matrix report")
+    ap.add_argument("--host-rate", type=float, default=0.25,
+                    help="per-check injection rate for the host-fault "
+                         "matrix cells")
     args = ap.parse_args()
+    if args.host_fault_matrix:
+        report = run_host_fault_matrix(rounds=args.rounds,
+                                       smoke=args.smoke, seed=args.seed,
+                                       rate=args.host_rate,
+                                       out_path=args.host_out)
+        print(json.dumps(report), flush=True)
+        return
     if args.attack_matrix:
         report = run_attack_matrix(rounds=args.rounds, smoke=args.smoke,
                                    tol_points=args.tol, seed=args.seed,

@@ -26,6 +26,14 @@
 #                         sign_flip must break plain mean by >5 pts
 #                         while >=1 robust rule holds within 5 —
 #                         docs/robustness.md threat-model table)
+#   host-chaos       scripts/chaos_suite.py --host-fault-matrix
+#                        -> HOST_CHAOS_AB.json (host-plane fault
+#                         drill: every HOST_FAULT_SEAMS seam injected
+#                         at the default rate must complete with a
+#                         bitwise-identical trajectory, fire its
+#                         retry/degraded counters+events, and a dead
+#                         stream producer must rebuild instead of
+#                         aborting — docs/robustness.md "Host plane")
 #   telemetry        scripts/telemetry_bench.py   -> TELEMETRY_AB.json
 #                        (off/default/debug overhead A/B on the
 #                         north-star config, <=1% acceptance) +
@@ -69,8 +77,9 @@ TRIES="${TPU_CAPTURE_WAIT_TRIES:-90}"   # ~6 h of patience by default
 # mfu leads: round 6 is the utilization round — the fused-vs-base A/B
 # and the first-ever on-chip traces are the highest-value capture if
 # the relay wedges mid-list
-DEFAULT_STEPS="mfu stream async attack telemetry bench-streaming \
-bench-dispatch bench-unroll bench zoo pallas flash-train vmap baseline"
+DEFAULT_STEPS="mfu stream async attack host-chaos telemetry \
+bench-streaming bench-dispatch bench-unroll bench zoo pallas \
+flash-train vmap baseline"
 STEPS="${*:-$DEFAULT_STEPS}"
 
 echo "[tpu_capture] waiting for the relay (up to ${TRIES}x120s probes)"
@@ -92,6 +101,9 @@ for step in $STEPS; do
         attack)         run python scripts/chaos_suite.py \
                             --attack-matrix --rounds 25 \
                             --attack-out ATTACK_AB.json ;;
+        host-chaos)     run python scripts/chaos_suite.py \
+                            --host-fault-matrix --rounds 12 \
+                            --host-out HOST_CHAOS_AB.json ;;
         telemetry)      run python scripts/telemetry_bench.py \
                             --capture-run artifacts/telemetry_northstar ;;
         conv-ab)        run env BENCH_CONV_IMPL=matmul python bench.py

@@ -89,11 +89,13 @@ class TestKeepLastN:
 
     def test_collect_round_keeps_sorts_numerically(self, tmp_path):
         d = str(tmp_path)
-        # r10 must outrank r9 (lexical order would GC it)
+        # r10 must outrank r9 (lexical order would GC it); content is
+        # legacy-unframed-shaped — a sub-magic-length stub would count
+        # as a torn frame and be swept regardless of retention
         for r in (2, 9, 10):
             with open(os.path.join(d, f"checkpoint_r{r}.ckpt"),
                       "wb") as f:
-                f.write(b"x")
+                f.write(b"legacy-unframed-checkpoint-bytes")
         removed = collect_round_keeps(d, 2)
         assert [os.path.basename(p) for p in removed] == \
             ["checkpoint_r2.ckpt"]

@@ -598,10 +598,12 @@ class TestAsyncCheckpointerLifecycle:
         # a non-registered callable is a no-op — this must not raise
         atexit.unregister(ck._atexit_close)
 
-    def test_atexit_close_swallows_errors(self, capsys):
+    def test_atexit_close_swallows_errors(self, capsys, monkeypatch):
         from fedtorch_tpu.utils import AsyncCheckpointer
         ck = AsyncCheckpointer()
-        ck._errors.append(RuntimeError("disk full"))
+        monkeypatch.setattr(
+            ck, "wait",
+            lambda: (_ for _ in ()).throw(RuntimeError("disk full")))
         ck._atexit_close()  # must not raise at interpreter exit
         assert ck._closed
         assert "atexit flush failed" in capsys.readouterr().err

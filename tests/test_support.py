@@ -232,9 +232,14 @@ class TestCheckpoint:
         ck.close()
         assert not (tmp_path / "p1").exists()
 
-    def test_async_checkpointer_surfaces_write_errors(self, tmp_path):
-        """A failed background write must raise on the next save/wait,
-        not vanish."""
+    def test_async_checkpointer_degrades_on_write_errors(self, tmp_path):
+        """A failed background write must not vanish — and must not
+        poison an unrelated later save() either (the pre-PR-10
+        behavior): the checkpointer flips to degraded SYNCHRONOUS
+        writes, so a persistent fault raises at the save that actually
+        hit it, with the lost write counted (docs/robustness.md 'Host
+        plane')."""
+        from fedtorch_tpu.robustness import host_recovery
         from fedtorch_tpu.utils import AsyncCheckpointer
         cfg = _cfg(tmp_path)
         data = build_federated_data(cfg)
@@ -244,14 +249,30 @@ class TestCheckpoint:
         server, clients = trainer.init_state(jax.random.key(0))
         blocker = tmp_path / "blocked"
         blocker.write_text("a file where a directory must go")
+        rec = host_recovery.HostRecovery(sleep_fn=lambda s: None)
+        rec.install()
         ck = AsyncCheckpointer()
         try:
             ck.save(str(blocker / "sub"), server, clients, cfg, 0.0,
                     False)
-            with pytest.raises(RuntimeError, match="async checkpoint"):
-                ck.wait()
+            ck.wait()  # no raise: the loss is recorded, not deferred
+            assert ck.degraded and ck.lost_writes == 1
+            assert ck.stats()["ckpt_degraded"] == 1.0
+            assert "ckpt.write" in rec.degraded
+            # degraded mode: the next save runs synchronously and the
+            # still-broken target raises HERE, honestly attributed
+            with pytest.raises(host_recovery.HostSeamError,
+                               match="ckpt.write"):
+                ck.save(str(blocker / "sub"), server, clients, cfg,
+                        0.0, False)
+            # a degraded checkpointer against a HEALTHY target keeps
+            # checkpointing (synchronously)
+            ck.save(str(tmp_path / "ok"), server, clients, cfg, 0.0,
+                    False)
+            assert (tmp_path / "ok" / "checkpoint.ckpt").exists()
         finally:
-            ck.close()  # wait() popped the error; close is clean
+            ck.close()
+            rec.uninstall()
 
 
 class TestCLI:
