@@ -67,11 +67,15 @@ BENCH_AB_KNOBS = {
     "BENCH_SCAN_UNROLL": "1",
     "BENCH_SINGLE_DISPATCH": "1",
     # BENCH_STREAMING=1 runs the round loop on the streaming data
-    # plane (--data_plane stream): host-resident client store,
-    # per-round dispatch with round-ahead feed prefetch. Necessarily a
-    # variant (never persisted as the north-star capture): it answers
-    # "what does the overlap cost on the real chip", the number
-    # STREAM_AB.json reads against the device default.
+    # plane (--data_plane stream): host-resident client store with
+    # round-ahead feed prefetch. Composes with BENCH_SINGLE_DISPATCH
+    # (the round-program builder's feed x scan cell: the producer
+    # packs one [TIMED_ROUNDS, ...] feed window for the scan);
+    # BENCH_SINGLE_DISPATCH=0 gives the per-round streamed loop.
+    # Necessarily a variant (never persisted as the north-star
+    # capture): it answers "what does the overlap cost on the real
+    # chip", the number STREAM_AB.json reads against the device
+    # default.
     "BENCH_STREAMING": "0",
 }
 
@@ -305,9 +309,11 @@ def main():
     # reverts to the per-round loop for A/B. Each mode warms up (and
     # compiles) only ITS OWN program — the other would be a wasted
     # 40-50s XLA compile on the relay-attached chip.
-    # the streaming plane is per-round dispatch by construction (the
-    # host must hand each round its feed; run_rounds refuses)
-    batched = ab_knob("BENCH_SINGLE_DISPATCH") == "1" and not streaming
+    # BENCH_STREAMING=1 composes with both dispatch modes since the
+    # round-program builder (parallel/round_program.py): batched
+    # streaming runs the SCANNED STREAMED program — the producer packs
+    # a [TIMED_ROUNDS, ...] feed window while the device scans.
+    batched = ab_knob("BENCH_SINGLE_DISPATCH") == "1"
     if batched:
         t0 = time.time()
         server, clients, _ = trainer.run_rounds(server, clients,

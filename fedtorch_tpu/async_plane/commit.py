@@ -40,24 +40,24 @@ SCAFFOLD are wired; families whose hooks read global round structure
 the buffer breaks (AFL/qFFL losses over the full cohort, DRFA's dual
 phase and lambda participation, the personalized families' val
 streams, qsparse's post-round tracking variate) raise a single
-ValueError at construction naming the gate — never deep in tracing.
+ValueError at construction naming the commit cell — the refusals live
+in ``parallel/round_program.py`` with the rest of the composition
+matrix, never deep in tracing. The commit PROGRAM itself is built
+there too (the one-step member of the round-program family); this
+module owns only the host side: the event scheduler, the snapshot-ring
+state wrap, and the commit-keyed feed producer.
 """
 from __future__ import annotations
 
 import weakref
-from typing import NamedTuple, Optional
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from fedtorch_tpu.algorithms.base import FedAlgorithm
-from fedtorch_tpu.async_plane.scheduler import (
-    ASYNC_TRAIN_SALT, AsyncSchedule,
-)
-from fedtorch_tpu.async_plane.staleness import (
-    normalized_staleness_weights,
-)
+from fedtorch_tpu.async_plane.scheduler import AsyncSchedule
 from fedtorch_tpu.config import ExperimentConfig
 from fedtorch_tpu.core.state import tree_broadcast_clients
 from fedtorch_tpu.data.batching import ClientData, round_row_plan
@@ -67,21 +67,17 @@ from fedtorch_tpu.data.streaming import (
 from fedtorch_tpu.models.common import ModelDef
 from fedtorch_tpu.parallel.federated import FederatedTrainer
 from fedtorch_tpu.parallel.mesh import replicate
-from fedtorch_tpu.robustness.chaos import draw_chaos_plan, no_chaos_plan
+from fedtorch_tpu.parallel.round_program import (
+    ASYNC_ALGORITHMS, ASYNC_TRAIN_SALT, CommitJobs,
+)
 from fedtorch_tpu.utils.tracing import instrument_trace
 
-ASYNC_ALGORITHMS = ("fedavg", "fedprox", "fedadam", "scaffold")
-
-
-class CommitJobs(NamedTuple):
-    """One commit's buffered updates as device inputs (all [m])."""
-    idx: jnp.ndarray        # int32 client ids (distinct)
-    version: jnp.ndarray    # int32 snapshot version each trained on
-    dispatch: jnp.ndarray   # int32 global dispatch counter (rng fold)
-    straggler: jnp.ndarray  # float32 {0,1} tail-delay dispatches
+__all__ = ["ASYNC_ALGORITHMS", "AsyncFederatedTrainer", "CommitJobs"]
 
 
 def _gate(why: str) -> ValueError:
+    """Host-scheduler feasibility refusals (buffer/population sizing);
+    the composition-matrix gates live in round_program.validate_cell."""
     return ValueError(
         f"sync_mode='async' is unsupported here: {why}; "
         "use --sync_mode sync")
@@ -133,33 +129,16 @@ class AsyncFederatedTrainer(FederatedTrainer):
     and the supervisor all work unchanged)."""
 
     supports_async = True
+    # run_round serves the COMMIT dispatch: the base constructor
+    # validates the (source x commit x execution) cell — algorithm,
+    # val-stream, fused and shard-gather refusals all ride the one
+    # validator in parallel/round_program.py
+    construction_dispatch = "commit"
 
     def __init__(self, cfg: ExperimentConfig, model: ModelDef,
                  algorithm: FedAlgorithm, data: ClientData,
                  val_data=None, mesh=None, gather_mode: str = "auto"):
         fed = cfg.federated
-        # -- the async gate matrix (tests/test_federated.py) ----------
-        alg_name = cfg.effective_algorithm
-        if alg_name not in ASYNC_ALGORITHMS:
-            raise _gate(
-                f"algorithm {alg_name!r} is not wired for stale-snapshot"
-                f" commits (supported: {', '.join(ASYNC_ALGORITHMS)}; "
-                "AFL/qFFL aggregate cohort-global losses, DRFA adds a "
-                "dual phase and lambda participation, the personalized "
-                "families need per-client val streams, and qsparse's "
-                "tracking variate assumes the round's payload sum)")
-        if val_data is not None or fed.personal:
-            raise _gate("per-client validation splits "
-                        "(cfg.federated.personal) are not buffered")
-        if cfg.mesh.client_fusion == "fused":
-            raise _gate("client_fusion='fused' packs clients into one "
-                        "grouped conv against ONE shared server "
-                        "snapshot; buffered commits train each client "
-                        "against its own version")
-        if gather_mode == "shard":
-            raise _gate("gather_mode='shard' moves whole client shards; "
-                        "the commit program packs each buffered job's "
-                        "rows (the 'batch' plan)")
         k_online = max(int(fed.online_client_rate * data.num_clients), 1)
         self.concurrency = fed.async_concurrency or k_online
         self.buffer_size = fed.async_buffer_size or max(
@@ -187,18 +166,21 @@ class AsyncFederatedTrainer(FederatedTrainer):
         self.mask_steps = self.epoch_sync
 
         self._sched: Optional[AsyncSchedule] = None
+        # the commit programs come from the round-program builder (the
+        # degenerate one-step scan of the family) — no commit-specific
+        # device code lives in this module anymore
         self.commit_trace_name = \
             f"federated.commit[{algorithm.name}]"
         self._commit_jit = jax.jit(
             instrument_trace(self.commit_trace_name,
-                             self._commit_device_fn),
+                             self.programs.build("commit")),
             donate_argnums=(0, 1)) \
             if self.data_plane == "device" else None
         self.commit_stream_trace_name = \
             f"federated.commit_stream[{algorithm.name}]"
         self._commit_stream_jit = jax.jit(
             instrument_trace(self.commit_stream_trace_name,
-                             self._commit_stream_fn),
+                             self.programs.build("commit")),
             donate_argnums=(0, 1)) \
             if self.data_plane == "stream" else None
 
@@ -213,105 +195,6 @@ class AsyncFederatedTrainer(FederatedTrainer):
                 "aux": tree_broadcast_clients(server.aux, R)}
         server = server._replace(aux={"alg": server.aux, "ring": ring})
         return replicate(server, self.mesh), clients
-
-    # -- the jitted commit program ---------------------------------------
-    def _commit_core(self, server, clients, jobs: CommitJobs, on_x, on_y,
-                     pre_x, pre_y, on_sizes, rngs, rng_round):
-        """Unwrap the ring, gather each job's snapshot, and re-dispatch
-        ``_round_core`` through its commit seam; then rotate the ring
-        with the new version."""
-        fed = self.cfg.federated
-        alg_aux = server.aux["alg"]
-        ring = server.aux["ring"]
-        inner = server._replace(aux=alg_aux)
-        R = self.snapshot_ring
-        slot = jobs.version % R
-        take = lambda t: jax.tree.map(
-            lambda x: jnp.take(x, slot, axis=0), t)
-        base_params, base_aux = take(ring["params"]), take(ring["aux"])
-        stale = (server.round - jobs.version).astype(jnp.float32)
-        weight_scale = normalized_staleness_weights(
-            stale, fed.staleness_weight, fed.staleness_exponent)
-
-        # chaos composes: crash/NaN faults draw their usual per-commit
-        # folds; the straggler BUDGET cut is neutralized (stragglers
-        # already arrived late — cutting their steps too would double-
-        # apply the fault)
-        m = jobs.idx.shape[0]
-        flt = self.fault
-        if self.chaos_on:
-            plan = draw_chaos_plan(
-                jax.random.fold_in(rng_round, flt.chaos_salt), m, flt
-            )._replace(budget_scale=jnp.ones((m,)))
-        else:
-            plan = no_chaos_plan(m)
-
-        # no buffered val plane (gated in __init__): same placeholders
-        # as the stream plane
-        on_vx, on_vy = on_x[:, :1], on_y[:, :1]
-        on_vsizes = jnp.ones_like(on_sizes)
-        new_inner, new_clients, metrics = self._round_core(
-            inner, clients, jobs.idx, on_x, on_y, on_vx, on_vy,
-            on_sizes, on_vsizes, pre_x, pre_y, rng_round, rngs,
-            batch_mode=True, val_batch_mode=False,
-            base_params=base_params, base_aux=base_aux,
-            weight_scale=weight_scale, plan=plan)
-
-        # rotate the ring: the new commit version overwrites the oldest
-        # retained slot (new_inner.round == server.round + 1)
-        new_slot = new_inner.round % R
-        new_ring = {
-            "params": jax.tree.map(
-                lambda r, p: r.at[new_slot].set(p),
-                ring["params"], new_inner.params),
-            "aux": jax.tree.map(
-                lambda r, a: r.at[new_slot].set(a),
-                ring["aux"], new_inner.aux),
-        }
-        new_server = new_inner._replace(
-            aux={"alg": new_inner.aux, "ring": new_ring})
-        metrics = metrics._replace(
-            straggler_clients=jnp.sum(jobs.straggler),
-            staleness_mean=jnp.mean(stale))
-        return new_server, new_clients, metrics
-
-    def _job_rngs(self, server, jobs: CommitJobs):
-        """Per-job training streams keyed by the GLOBAL dispatch
-        counter, not the commit index — two dispatches of one client
-        against different versions must not share a batch order."""
-        return jax.vmap(lambda d: jax.random.fold_in(
-            jax.random.fold_in(server.rng, ASYNC_TRAIN_SALT), d)
-        )(jobs.dispatch)
-
-    def _commit_device_fn(self, server, clients, jobs: CommitJobs,
-                          data: ClientData):
-        """Device data plane: gather each buffered job's rows in-program
-        (the same ``round_row_plan`` the host feed packer replays, so
-        the two async data planes are bitwise-identical)."""
-        K, B = self.local_steps, self.batch_size
-        rng_round = jax.random.fold_in(server.rng, server.round)
-        rngs = self._job_rngs(server, jobs)
-        idx = jobs.idx
-        on_sizes = jnp.take(data.sizes, idx)
-        rows = jax.vmap(lambda r, s: round_row_plan(
-            r, s, data.x.shape[1], K * B))(rngs, on_sizes)
-        on_x = data.x[idx[:, None], rows]
-        on_y = data.y[idx[:, None], rows]
-        pre_x = data.x[idx[:, None], jnp.arange(B)[None, :]]
-        pre_y = data.y[idx[:, None], jnp.arange(B)[None, :]]
-        return self._commit_core(server, clients, jobs, on_x, on_y,
-                                 pre_x, pre_y, on_sizes, rngs, rng_round)
-
-    def _commit_stream_fn(self, server, clients, jobs: CommitJobs,
-                          feed):
-        """Streaming data plane: the commit consumes a host-packed feed
-        built one COMMIT ahead by the producer (keyed by commit
-        version, not round index)."""
-        rng_round = jax.random.fold_in(server.rng, server.round)
-        rngs = self._job_rngs(server, jobs)
-        return self._commit_core(server, clients, jobs, feed.x, feed.y,
-                                 feed.pre_x, feed.pre_y, feed.sizes,
-                                 rngs, rng_round)
 
     # -- host-side commit loop -------------------------------------------
     def _schedule_args(self) -> dict:
@@ -394,36 +277,35 @@ class AsyncFederatedTrainer(FederatedTrainer):
                           straggler=plan.straggler)
         return self._commit_jit(server, clients, jobs, self.data)
 
-    def run_rounds(self, server, clients, num_rounds: int):
-        raise ValueError(
-            "run_rounds is unsupported on the async commit plane: it "
-            "scans ONE traced round program over device-resident data, "
-            "but async commits are host-scheduled events (each commit's "
-            "jobs come from the event scheduler) — call run_round once "
-            "per commit (docs/robustness.md 'Asynchronous federation')")
+    # NOTE: run_rounds is NOT overridden — the base method's scan-cell
+    # validation (parallel/round_program.py) raises the one cell-named
+    # ValueError at call time: async commits are host-scheduled events,
+    # so no R-commit program exists for run_rounds to scan.
 
     def lowered_cost_programs(self, server, clients,
                               num_scan_rounds: int = 0):
         """The async twin of the base trainer's cost-capture handles:
-        the COMMIT program (per data plane), lowered from an
-        uninstrumented twin against abstract [m] job inputs — no
-        scheduler state is consumed and the sentinel sees nothing.
-        ``num_scan_rounds`` is ignored (run_rounds refuses here)."""
+        the COMMIT program (per data plane) from the round-program
+        builder, lowered uninstrumented against abstract [m] job
+        inputs — no scheduler state is consumed and the sentinel sees
+        nothing. ``num_scan_rounds`` is ignored (the scan cell is
+        refused on this plane)."""
         m = self.buffer_size
         sds = lambda shape, dt: jax.ShapeDtypeStruct(shape, dt)
         jobs = CommitJobs(idx=sds((m,), jnp.int32),
                           version=sds((m,), jnp.int32),
                           dispatch=sds((m,), jnp.int32),
                           straggler=sds((m,), jnp.float32))
+        commit_fn = self.programs.build("commit")
         if self.data_plane == "stream":
             primary = "commit_stream"
             lowered = jax.jit(
-                self._commit_stream_fn, donate_argnums=(0, 1)).lower(
+                commit_fn, donate_argnums=(0, 1)).lower(
                 server, clients, jobs, self._feed_struct(k=m))
         else:
             primary = "commit"
             lowered = jax.jit(
-                self._commit_device_fn, donate_argnums=(0, 1)).lower(
+                commit_fn, donate_argnums=(0, 1)).lower(
                 server, clients, jobs, self.data)
         return {primary: lowered}, primary
 

@@ -35,11 +35,15 @@ import jax.numpy as jnp
 import numpy as np
 
 from fedtorch_tpu.data.streaming import _cpu_device, _cpu_scope
+# the per-dispatch local-training salt lives with the round-program
+# family whose PRNG contract it is (parallel/round_program.py);
+# re-exported here for the host-replay twins that import it
+from fedtorch_tpu.parallel.round_program import ASYNC_TRAIN_SALT  # noqa: F401
 
-# fold constants separating the async plane's PRNG streams from the
-# round streams (chaos_salt 0x7FFFFFFD and the augmentation parent
-# 0x7FFFFFFF are taken; all are < 2^31 so fold_in accepts them)
-ASYNC_TRAIN_SALT = 0x7FFFFFF9   # per-dispatch local-training stream
+# fold constants separating the scheduler's PRNG streams from the
+# round streams (chaos_salt 0x7FFFFFFD, the augmentation parent
+# 0x7FFFFFFF and ASYNC_TRAIN_SALT 0x7FFFFFF9 are taken; all are
+# < 2^31 so fold_in accepts them)
 _DELAY_SALT = 0x7FFFFFF7        # per-dispatch completion delay
 _SELECT_SALT = 0x7FFFFFF5       # per-replacement client selection
 

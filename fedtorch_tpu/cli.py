@@ -393,6 +393,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "'debug' re-exports the trace every 25 rounds; "
                         "'off' disables everything "
                         "(docs/observability.md)")
+    p.add_argument("--cost_capture_scan_rounds", type=int, default=0,
+                   help="> 0 additionally AOT-lowers the scan-of-R "
+                        "round-program twin for the active data "
+                        "source into program_costs.json at the "
+                        "one-shot cost capture (rounds_scan[R] on "
+                        "the device plane, rounds_stream_scan[R] — "
+                        "the scanned streamed program — on the "
+                        "stream plane); 0 captures the per-round "
+                        "programs only. Ignored (with a logged note) "
+                        "under --sync_mode async, whose commit plane "
+                        "refuses the scan dispatch")
     return p
 
 
@@ -508,7 +519,9 @@ def args_to_config(args) -> ExperimentConfig:
             compute_dtype=args.compute_dtype,
             scan_unroll=args.scan_unroll, remat=args.remat,
             client_fusion=args.client_fusion),
-        telemetry=TelemetryConfig(level=args.telemetry),
+        telemetry=TelemetryConfig(
+            level=args.telemetry,
+            cost_capture_scan_rounds=args.cost_capture_scan_rounds),
         fault=FaultConfig(
             client_drop_rate=args.fault_client_drop_rate,
             straggler_rate=args.fault_straggler_rate,
@@ -743,6 +756,15 @@ def run_experiment(cfg: ExperimentConfig,
         # (HLO byte-identical, sentinel holds; pinned in
         # tests/test_device_observability.py)
         cost_capture = None
+        if cfg.telemetry.cost_capture_scan_rounds > 0 \
+                and cfg.federated.sync_mode == "async":
+            # the async trainer's lowered_cost_programs ignores
+            # num_scan_rounds (its commit plane refuses the scan
+            # dispatch) — say so instead of silently dropping the flag
+            logger.log(
+                "cost capture: --cost_capture_scan_rounds is ignored "
+                "under sync_mode='async' (the commit plane refuses "
+                "the scan dispatch; capturing the commit program only)")
         if tel.enabled and tel.is_writer:
             from fedtorch_tpu.telemetry.costs import ProgramCostCapture
             cost_capture = ProgramCostCapture(
@@ -815,7 +837,10 @@ def run_experiment(cfg: ExperimentConfig,
                 with tel.span("cost_capture", round=r):
                     try:
                         programs, primary = \
-                            trainer.lowered_cost_programs(server, clients)
+                            trainer.lowered_cost_programs(
+                                server, clients,
+                                num_scan_rounds=cfg.telemetry
+                                .cost_capture_scan_rounds)
                         try:
                             from fedtorch_tpu.parallel.evaluate import (
                                 lowered_eval_program,

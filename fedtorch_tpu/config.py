@@ -93,14 +93,20 @@ class DataConfig:
     synthetic_regression: bool = False
     # Adult sensitive-feature split (ref: parameters.py:37).
     sensitive_feature: int = 9
-    # Federated data plane (docs/performance.md "Streaming data
-    # plane"): 'device' shards every client's rows into HBM at trainer
-    # construction and hands the full [C, n_max, ...] pytree to each
-    # jitted round (the reference-faithful seed behavior — population
-    # capped by device memory); 'stream' keeps the client store
-    # host-resident and feeds each round the K online clients' packed
-    # rows, built and transferred one round ahead of device compute
-    # (population capped by host RAM; bitwise-identical trajectories).
+    # Federated data plane — the round-program builder's data-source
+    # axis (docs/performance.md "The round-program builder"): 'device'
+    # shards every client's rows into HBM at trainer construction and
+    # hands the full [C, n_max, ...] pytree to each jitted round (the
+    # reference-faithful seed behavior — population capped by device
+    # memory); 'stream' keeps the client store host-resident and feeds
+    # each dispatch the K online clients' packed rows — one feed per
+    # round, or an [R, ...] feed window under the scanned dispatch
+    # (run_rounds) — built and transferred one dispatch ahead of
+    # device compute (population capped by host RAM;
+    # bitwise-identical trajectories). Both values compose with every
+    # dispatch (per-round | scan | async commit) and execution
+    # (vmap | fused) the cell validator allows
+    # (parallel/round_program.py).
     data_plane: str = "device"
     # Batching (ref: parameters.py:131-141).
     batch_size: int = 50
@@ -500,6 +506,16 @@ class TelemetryConfig:
     # span-buffer bound: past this, new spans are counted as dropped
     # instead of growing host memory on month-long runs
     max_span_events: int = 200_000
+    # > 0: the one-shot cost capture additionally AOT-lowers the
+    # scan-of-R round-program twin for the active data source
+    # (rounds_scan[R] on the device plane, rounds_stream_scan[R] — the
+    # scanned streamed program — on the stream plane) into
+    # program_costs.json, so the composed builder dispatch is
+    # cost-attributed alongside the per-round primary
+    # (parallel/round_program.py; telemetry/costs.py). 0 = per-round
+    # programs only (the default; the scan twin is a second XLA
+    # compile at capture time).
+    cost_capture_scan_rounds: int = 0
 
 
 @dataclass(frozen=True)
@@ -770,6 +786,11 @@ class ExperimentConfig:
             raise ValueError(
                 "telemetry.max_span_events must be >= 1, got "
                 f"{self.telemetry.max_span_events}")
+        if self.telemetry.cost_capture_scan_rounds < 0:
+            raise ValueError(
+                "telemetry.cost_capture_scan_rounds must be >= 0 "
+                "(0 = per-round programs only), got "
+                f"{self.telemetry.cost_capture_scan_rounds}")
 
         return dataclasses.replace(
             self, data=data, federated=fed, train=train, optim=optim)

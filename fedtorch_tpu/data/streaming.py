@@ -24,10 +24,18 @@ set into a packed :class:`RoundFeed`:
   double-buffered, so the steady-state H2D transfer hides under device
   compute and device-side data residency drops from ``O(C*n_max)`` to
   ``O(2*k*K*B)``.
+* **Feed windows** (the scanned streamed program —
+  parallel/round_program.py): under the scan dispatch the producer
+  packs ``window`` consecutive rounds into one ``[R, k, K*B, ...]``
+  stacked feed (ONE flat gather per tensor — ``pack_window``) and the
+  device ``lax.scan``\\ s window r while window r+1 builds; residency
+  becomes ``O((depth+1)*R*k*K*B)`` — R trades device memory for
+  dispatch count.
 
 The trainer-side consumer is ``FederatedTrainer.round_stream_fn``
-(parallel/federated.py), which funnels the feed into the same
-``_round_core`` the device plane uses — the bitwise-parity contract.
+(parallel/federated.py) — per feed, or scanned over the window —
+which funnels into the same ``_round_core`` the device plane uses:
+the bitwise-parity contract holds in every cell.
 """
 from __future__ import annotations
 
@@ -132,6 +140,21 @@ class HostClientStore:
             pre_y=self._gather(self._flat_y, pre).reshape(
                 (k, batch_size) + feat_y))
 
+    def pack_window(self, idxs: np.ndarray, rowss: np.ndarray,
+                    batch_size: int) -> RoundFeed:
+        """Pack an ``[R, ...]``-stacked feed WINDOW (the scanned
+        streamed program's input) in ONE gather per tensor: the R
+        rounds' ``[R, k]`` client ids and ``[R, k, rows]`` row plans
+        flatten to an ``[R*k]``-client pack, and the contiguous
+        reshape back to ``[R, k, ...]`` is free — no per-round
+        feeds + stack copy."""
+        R, k = np.asarray(idxs).shape
+        feed = self.pack(np.asarray(idxs).reshape(-1),
+                         np.asarray(rowss).reshape(R * k, -1),
+                         batch_size)
+        return RoundFeed(*(a.reshape((R, k) + a.shape[1:])
+                           for a in feed))
+
 
 def _cpu_device():
     """The CPU backend device for schedule replay, or None when the
@@ -234,13 +257,33 @@ class StreamFeedProducer:
                  local_steps: Optional[int] = None,
                  place_fn: Optional[Callable] = None, depth: int = 2,
                  timeout_s: float = 120.0,
-                 plan_fn: Optional[Callable] = None):
+                 plan_fn: Optional[Callable] = None, window: int = 0):
         self.store = store
         self.start_round = int(start_round)
         self.batch_size = batch_size
         self._place = place_fn if place_fn is not None else jax.device_put
         self._timeout_s = timeout_s
         self._plan_fn = plan_fn
+        # window >= 1 is the SCANNED STREAMED program's producer
+        # (parallel/round_program.py): each produced item packs
+        # ``window`` consecutive rounds' feeds stacked on a leading
+        # [R] axis (R == 1 included — the scan still wants its leading
+        # axis), so the device can lax.scan window r while this thread
+        # builds window r+1 — the feed's label is the window's FIRST
+        # round and consumption advances by ``window`` rounds per pop.
+        # window == 0 (default) is the per-round producer: flat feeds,
+        # one per round. plan_fn producers (the async commit plane)
+        # stay per-commit: a commit is already a one-step program.
+        self.window = int(window)
+        if self.window < 0:
+            raise ValueError(f"window must be >= 0, got {window}")
+        if plan_fn is not None and self.window != 0:
+            raise ValueError(
+                "plan_fn producers (the async commit plane) produce "
+                "one feed per commit; feed windows are the scanned "
+                "round schedule's (window must be 0 with plan_fn)")
+        # rounds consumed per pop (a flat feed covers one round)
+        self._stride = max(self.window, 1)
         if plan_fn is None:
             self.feed_rows = local_steps * batch_size
             self._schedule = RoundSchedule(
@@ -271,6 +314,15 @@ class StreamFeedProducer:
             return self.store.pack(idx, rows, self.batch_size)
         return host_recovery.retry(attempt, "stream.gather")
 
+    def _pack_window(self, idxs, rowss) -> RoundFeed:
+        """The window twin of :meth:`_pack_feed`: same chaos seams,
+        same bounded retry, one flat gather for the whole window."""
+        def attempt():
+            host_chaos.maybe_delay("stream.delay")
+            host_chaos.maybe_raise("stream.gather")
+            return self.store.pack_window(idxs, rowss, self.batch_size)
+        return host_recovery.retry(attempt, "stream.gather")
+
     def _place_feed(self, feed, extras):
         """The device_put dispatch attempt ('stream.h2d' seam):
         re-placing a host feed is idempotent (another transfer of the
@@ -286,11 +338,25 @@ class StreamFeedProducer:
         with telemetry.span("stream.gather", step=step):
             if self._plan_fn is not None:
                 label, idx, rows, extras = self._plan_fn(step)
-            else:
+                feed = self._pack_feed(idx, rows)
+            elif self.window == 0:
                 label = self.start_round + step
                 idx, rows = self._schedule(label)
                 extras = None
-            feed = self._pack_feed(idx, rows)
+                feed = self._pack_feed(idx, rows)
+            else:
+                # scanned-stream window: replay `window` consecutive
+                # rounds' index plans, then ONE flat gather packs the
+                # whole [R, k, K*B, ...] window (pack_window — no
+                # per-round feeds + stack copy; host residency: one
+                # window; the device holds at most depth+1 windows)
+                label = self.start_round + step * self.window
+                extras = None
+                plans = [self._schedule(label + j)
+                         for j in range(self.window)]
+                idxs = np.stack([p[0] for p in plans])
+                rowss = np.stack([p[1] for p in plans])
+                feed = self._pack_window(idxs, rowss)
         t1 = time.perf_counter()
         # device_put dispatches the H2D copy and returns immediately —
         # the transfer rides behind the in-flight round's compute (so
@@ -300,7 +366,9 @@ class StreamFeedProducer:
             placed = self._place_feed(feed, extras)
         self.gather_s += t1 - t0
         self.h2d_s += time.perf_counter() - t1
-        self.rounds_produced += 1
+        # a feed window counts as its width in rounds (the gauge is
+        # rounds of data produced, not queue items)
+        self.rounds_produced += self._stride
         return label, placed
 
     def next_feed(self) -> RoundFeed:
@@ -320,7 +388,8 @@ class StreamFeedProducer:
                 f"{self._expected} expected — the producer desynced "
                 "from the training state (rollback/resume without "
                 "invalidate_stream?)")
-        self._expected += 1
+        # a window advances the round cursor by its full width
+        self._expected += self._stride
         return feed
 
     def alive(self) -> bool:

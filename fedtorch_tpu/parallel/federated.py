@@ -29,14 +29,16 @@ Design (SURVEY.md §7):
   delta-as-grad with rank weights) and tree-summed over the client axis —
   a ``psum``-shaped reduction XLA lowers onto ICI. Every device applies
   the same server step (replicated-server semantics, fedavg.py:89-97).
-* Data planes (docs/performance.md "Streaming data plane"):
-  ``cfg.data.data_plane='device'`` (default) holds the full client
-  store in HBM and gathers the online rows in-program; ``'stream'``
-  keeps the store host-resident and the jitted round consumes a
-  host-packed per-round feed (``round_stream_fn``) built one round
-  ahead by ``data/streaming.py``. Both planes funnel into
-  ``_round_core`` and share ``round_row_plan``, so trajectories are
-  bitwise-identical across planes.
+* Program composition (parallel/round_program.py — the round-program
+  builder): data source (resident HBM store with in-program gathers |
+  host-packed feed built ahead by ``data/streaming.py``) x dispatch
+  (per-round | ``lax.scan``-of-R, incl. the scanned streamed program
+  over an [R, ...] feed window | async one-step commit) x client
+  execution (vmap | fused) compose orthogonally; illegal cells are
+  refused by ONE named ValueError from ``validate_cell``. Every cell
+  funnels into ``_round_core`` and shares ``round_row_plan``, so
+  trajectories are bitwise-identical across sources and dispatches
+  (docs/performance.md "The round-program builder").
 * Fault tolerance (docs/robustness.md): ``cfg.fault`` drives a
   deterministic in-program chaos layer (client crashes masked out of
   aggregation with weight renormalization, straggler step cuts on the
@@ -82,6 +84,9 @@ from fedtorch_tpu.data.streaming import (
 from fedtorch_tpu.models.common import ModelDef
 from fedtorch_tpu.ops.augment import augment_image_batch
 from fedtorch_tpu.parallel.fusion import resolve_client_fusion
+from fedtorch_tpu.parallel.round_program import (
+    RoundProgramBuilder, resolve_gather_mode,
+)
 from fedtorch_tpu.parallel.mesh import (
     client_sharding, make_mesh, padded_client_count, replicate,
     replicated_sharding, shard_clients,
@@ -123,6 +128,10 @@ class FederatedTrainer:
     # async config would silently run round-synchronous semantics, so
     # it refuses instead (docs/robustness.md "Asynchronous federation")
     supports_async = False
+    # the dispatch-axis value this class serves from run_round — the
+    # round-program cell validated at construction ('commit' on the
+    # async subclass); the scan cell validates at run_rounds call time
+    construction_dispatch = "round"
 
     def __init__(self, cfg: ExperimentConfig, model: ModelDef,
                  algorithm: FedAlgorithm, data: ClientData,
@@ -140,10 +149,6 @@ class FederatedTrainer:
         self.algorithm = algorithm
         self.num_clients = data.num_clients
         self.batch_size = cfg.data.batch_size
-        if algorithm.needs_val_batch and val_data is None:
-            raise ValueError(
-                f"{algorithm.name} needs per-client validation batches; "
-                "pass FederatedData.val (cfg.federated.personal builds it)")
         # static online-client count (online_client_rate, misc.py:14)
         self.k_online = max(
             int(cfg.federated.online_client_rate * self.num_clients), 1)
@@ -176,54 +181,20 @@ class FederatedTrainer:
         self.robust_rule = cfg.fault.robust_agg
         self.robust_momentum = self.robust_rule == "norm_bound"
 
-        # 'batch' gathers only the K*B rows each online client will touch
-        # this round (bounds cross-device movement when K*B < shard
-        # size); 'shard' moves whole client shards and indexes per step —
-        # required when the algorithm reads the full local dataset (qFFL's
-        # full loss) and cheaper when a round revisits the shard (K*B >=
-        # n_max, e.g. epoch-sync with several epochs per round).
-        if gather_mode not in ("auto", "shard", "batch"):
-            raise ValueError(f"unknown gather_mode {gather_mode!r}")
-        # the streaming data plane (docs/performance.md "Streaming data
-        # plane"): the client store stays host-resident and each round
-        # consumes a host-packed feed of the touched rows. The feed IS
-        # the 'batch' row plan, so 'shard' has no streamed meaning.
+        # data source + gather mode: the refusals (explicit 'shard' on
+        # a packed-row program, feed-source algorithm preconditions,
+        # 'batch' under a full-loss algorithm) all live in the ONE
+        # round-program cell validator (parallel/round_program.py) —
+        # the builder validation call below raises them by cell name.
         self.data_plane = cfg.data.data_plane
-        if self.data_plane == "stream":
-            why = None
-            if algorithm.needs_full_loss:
-                why = (f"{algorithm.name} evaluates each client's FULL "
-                       "local dataset every round (gather_mode='shard')")
-            elif (type(algorithm).participation
-                    is not FedAlgorithm.participation
-                    or type(algorithm).post_round_global
-                    is not FedAlgorithm.post_round_global):
-                why = (f"{algorithm.name} overrides participation/"
-                       "post_round_global with server-state-dependent "
-                       "logic the host feed builder cannot replay")
-            elif algorithm.needs_val_batch or val_data is not None:
-                why = ("per-client validation splits "
-                       "(cfg.federated.personal) are not streamed yet")
-            if why is not None:
-                raise ValueError(
-                    f"data_plane='stream' is unsupported here: {why}; "
-                    "use --data_plane device")
-            if gather_mode == "shard":
-                raise ValueError(
-                    "gather_mode='shard' moves whole client shards on "
-                    "device; the streaming plane packs rows host-side "
-                    "— use gather_mode 'auto' or 'batch'")
-            gather_mode = "batch"
-        if gather_mode == "auto":
-            gather_mode = "shard" if (
-                algorithm.needs_full_loss
-                or self.local_steps * self.batch_size >= data.n_max) \
-                else "batch"
-        if gather_mode == "batch" and algorithm.needs_full_loss:
-            raise ValueError(
-                f"{algorithm.name} requires gather_mode='shard' "
-                "(it evaluates the full local dataset each round)")
-        self.gather_mode = gather_mode
+        self.has_val = val_data is not None
+        # the EXPLICIT (pre-resolution) mode is what the cell validator
+        # judges; the resolved mode drives the in-program gather
+        self.explicit_gather_mode = gather_mode
+        self.gather_mode = resolve_gather_mode(
+            gather_mode, algorithm=algorithm,
+            data_plane=self.data_plane, local_steps=self.local_steps,
+            batch_size=self.batch_size, n_max=data.n_max)
         # train-time flip+crop augmentation for image batches (the
         # reference's cifar transform, prepare_data.py:29-35);
         # ClientData x is [clients, N, H, W, C] for image datasets
@@ -250,6 +221,17 @@ class FederatedTrainer:
         self.client_fusion, self.fused_module = resolve_client_fusion(
             cfg, model, algorithm, int(self.mesh.devices.size),
             self.k_online)
+        # the round-program builder (parallel/round_program.py): the
+        # ONE place programs are composed and cells are refused. The
+        # construction-time dispatch ('round' here, 'commit' on the
+        # async subclass) validates now; the scan cell validates when
+        # run_rounds is actually called.
+        self.programs = RoundProgramBuilder(self)
+        self.programs.validate(self.construction_dispatch)
+        if algorithm.needs_val_batch and val_data is None:
+            raise ValueError(
+                f"{algorithm.name} needs per-client validation batches; "
+                "pass FederatedData.val (cfg.federated.personal builds it)")
         # the client axis is padded up to a multiple of the mesh size with
         # inert (never-sampled, size-0) clients so EVERY device holds an
         # equal shard — no chip idles when num_clients has no large
@@ -446,8 +428,8 @@ class FederatedTrainer:
         the streaming plane, which gates such algorithms out, passes
         None.
 
-        COMMIT-DISPATCH SEAM (async_plane/commit.py; a down payment on
-        the ROADMAP-4 round-program compiler): the keyword overrides
+        COMMIT-DISPATCH SEAM (parallel/round_program.py — the commit
+        member of the round-program family): the keyword overrides
         let a caller re-dispatch this same core as an asynchronous
         buffered COMMIT instead of a synchronous round —
         ``base_params``/``base_aux`` thread a PER-CLIENT [k] server
@@ -1064,13 +1046,19 @@ class FederatedTrainer:
         return None
 
     # -- streaming feed plumbing (data_plane='stream') --------------------
-    def _next_stream_feed(self, server) -> RoundFeed:
-        """Pop the next round's host-packed feed, (re)starting the
-        producer from the LIVE device state on first use or after
-        :meth:`invalidate_stream`. The (rng, round) fetch is one
-        batched ``device_get`` paid only at (re)start — steady-state
-        rounds consume prefetched feeds without touching the device
-        stream, and the producer stays >= 1 round ahead."""
+    def _next_stream_feed(self, server, window: int = 0) -> RoundFeed:
+        """Pop the next host-packed feed (``window == 0``, run_round)
+        or ``[window, ...]`` stacked feed window (the scanned streamed
+        program, run_rounds), (re)starting the producer from the LIVE
+        device state on first use, after :meth:`invalidate_stream`, or
+        when the dispatch granularity changes (feeds are strictly
+        sequential per producer, so a window switch re-syncs). The
+        (rng, round) fetch is one batched ``device_get`` paid only at
+        (re)start — steady-state dispatches consume prefetched feeds
+        without touching the device stream, and the producer stays
+        >= 1 window ahead."""
+        if self._stream is not None and self._stream.window != window:
+            self.invalidate_stream()
         if self._stream is None:
             key_data, round0 = jax.device_get(
                 (jax.random.key_data(server.rng), server.round))
@@ -1083,7 +1071,7 @@ class FederatedTrainer:
                 key_impl=jax.random.key_impl(server.rng),
                 start_round=int(round0), num_clients=self.num_clients,
                 k_online=self.k_online, local_steps=self.local_steps,
-                batch_size=self.batch_size,
+                batch_size=self.batch_size, window=window,
                 place_fn=lambda t: replicate(t, mesh))
             # leak guard: a trainer dropped WITHOUT invalidate_stream
             # must not orphan the producer thread (it would pin the
@@ -1157,50 +1145,52 @@ class FederatedTrainer:
         round program scanned with ``lax.scan``, so the host dispatches
         once instead of once per round (no per-round Python/dispatch
         gap on the device timeline — the bench path). Metrics come back
-        with a leading [num_rounds] axis. Trajectories equal
-        ``num_rounds`` calls of :meth:`run_round` to float tolerance
-        (same ops; the scan body is a separate XLA compilation, which
-        may reassociate float math). One jitted driver is cached per
-        distinct ``num_rounds``.
+        with a leading [num_rounds] axis. Per-round trajectories equal
+        ``num_rounds`` calls of :meth:`run_round` (bitwise on XLA CPU —
+        pinned per cell in tests/test_round_builder.py; the scan body
+        is a separate XLA compilation, so other backends may
+        reassociate float math at ulp level). One jitted driver is
+        cached per distinct (source, ``num_rounds``).
 
-        This is the DEVICE-resident fast path: the scan closes over
-        the full data pytree in HBM. The streaming plane necessarily
-        dispatches per round — the host must be in the loop to hand
-        each round its feed (and that per-round gap is what the
-        round-ahead prefetch hides) — so it refuses here instead of
-        silently changing the dispatch granularity."""
-        if self.data_plane == "stream":
-            raise RuntimeError(
-                "run_rounds scans the round program over device-resident "
-                "data (single-dispatch fast path); data_plane='stream' "
-                "dispatches per round so the host can overlap the next "
-                "feed — call run_round in a loop (docs/performance.md "
-                "'Streaming data plane')")
-        if num_rounds not in self._rounds_jit:
-            self._rounds_jit[num_rounds] = jax.jit(
+        Both data sources scan. On the resident source the scan closes
+        over the full data pytree in HBM (the seed fast path). On the
+        feed source this is the SCANNED STREAMED program: the producer
+        packs an ``[num_rounds, k, K*B, ...]`` feed WINDOW — window
+        r+1 built while the device scans window r — so the stream
+        plane gets the dispatch lever and the producer overlap has a
+        whole window of compute to hide under. Device feed residency
+        grows from O((depth+1)*k*K*B) to O((depth+1)*R*k*K*B).
+        Switching dispatch granularity mid-run (run_round <->
+        run_rounds, or a different ``num_rounds``) re-syncs the
+        producer from live device state — one batched fetch, exact
+        replay. The async commit plane refuses here with the
+        cell-named ValueError (commits are host-scheduled events)."""
+        if num_rounds < 1:
+            # refuse BEFORE any feed is consumed: a zero-length scan
+            # traces to an obscure shape error, and on the stream
+            # plane it would first pop (and lose) a real feed —
+            # desyncing the producer from the device round
+            raise ValueError(
+                f"run_rounds needs num_rounds >= 1, got {num_rounds}")
+        key = (self.programs.source, num_rounds)
+        if key not in self._rounds_jit:
+            # build() validates the scan cell — the one error site;
+            # the async plane's refusal fires here, at call time
+            fn = self.programs.build("scan", scan_length=num_rounds)
+            suffix = "" if self.programs.source == "resident" \
+                else "_stream"
+            self._rounds_jit[key] = jax.jit(
                 instrument_trace(
-                    f"federated.rounds[{self.algorithm.name}]"
-                    f"x{num_rounds}", self._build_rounds_fn(num_rounds)),
+                    f"federated.rounds{suffix}[{self.algorithm.name}]"
+                    f"x{num_rounds}", fn),
                 donate_argnums=(0, 1))
-        return self._rounds_jit[num_rounds](server, clients, self.data,
-                                            self.val_data)
-
-    def _build_rounds_fn(self, num_rounds: int):
-        """The ``run_rounds`` scan driver as a plain function — shared
-        by the live jit above and the uninstrumented cost-capture twin
-        (:meth:`lowered_cost_programs`), so the two lower the same
-        program by construction."""
-        def rounds_fn(server, clients, data, val_data):
-            def body(carry, _):
-                s, c = carry
-                s, c, m = self.round_fn(s, c, data, val_data)
-                return (s, c), m
-
-            (s, c), ms = jax.lax.scan(
-                body, (server, clients), None, length=num_rounds)
-            return s, c, ms
-
-        return rounds_fn
+        if self.data_plane == "stream":
+            window = self._pop_stream_with_rebuild(
+                lambda: self._next_stream_feed(server,
+                                               window=num_rounds))
+            return self._rounds_jit[key](server, clients, window)
+        return self._rounds_jit[key](server, clients, self.data,
+                                     self.val_data)
 
     # -- compiled-program cost capture (telemetry.costs) ------------------
     def _feed_struct(self, k: Optional[int] = None) -> RoundFeed:
@@ -1221,6 +1211,15 @@ class FederatedTrainer:
             pre_x=sds((k, self.batch_size) + fx, st.x.dtype),
             pre_y=sds((k, self.batch_size) + fy, st.y.dtype))
 
+    def _window_struct(self, num_rounds: int) -> RoundFeed:
+        """Abstract twin of a packed ``[R, ...]`` feed window — the
+        scanned streamed program's data input (:meth:`_feed_struct`
+        with a leading window axis)."""
+        return jax.tree.map(
+            lambda s: jax.ShapeDtypeStruct(
+                (num_rounds,) + s.shape, s.dtype, sharding=s.sharding),
+            self._feed_struct())
+
     def lowered_cost_programs(self, server, clients,
                               num_scan_rounds: int = 0):
         """``({name: jax.stages.Lowered}, primary_name)`` for this
@@ -1231,8 +1230,11 @@ class FederatedTrainer:
         sentinel sees zero extra trace events, and the live jit caches
         are untouched. ``primary`` names the per-round program whose
         FLOPs feed the measured-MFU gauge. ``num_scan_rounds > 0``
-        additionally lowers the ``run_rounds`` scan-of-R driver
-        (device plane only — the bench path's dispatch shape).
+        additionally lowers the ``run_rounds`` scan-of-R driver for
+        the active data source — the composed builder programs
+        (resident scan AND the scanned streamed program) are both
+        cost-capturable, against an abstract feed-window struct on the
+        feed source so no prefetched feed is consumed.
 
         Lowering alone executes no device work; compiling the twins
         (telemetry.costs.lowered_cost) re-uses the persistent XLA
@@ -1243,6 +1245,14 @@ class FederatedTrainer:
             programs[primary] = jax.jit(
                 self.round_stream_fn, donate_argnums=(0, 1)).lower(
                 server, clients, self._feed_struct())
+            if num_scan_rounds > 0:
+                programs[f"rounds_stream_scan[{num_scan_rounds}]"] = \
+                    jax.jit(
+                        self.programs.build(
+                            "scan", scan_length=num_scan_rounds),
+                        donate_argnums=(0, 1)).lower(
+                        server, clients,
+                        self._window_struct(num_scan_rounds))
         else:
             primary = "round"
             programs[primary] = jax.jit(
@@ -1250,7 +1260,8 @@ class FederatedTrainer:
                 server, clients, self.data, self.val_data)
             if num_scan_rounds > 0:
                 programs[f"rounds_scan[{num_scan_rounds}]"] = jax.jit(
-                    self._build_rounds_fn(num_scan_rounds),
+                    self.programs.build(
+                        "scan", scan_length=num_scan_rounds),
                     donate_argnums=(0, 1)).lower(
                     server, clients, self.data, self.val_data)
         return programs, primary

@@ -1,5 +1,6 @@
-"""Client-fusion gate: which round programs may pack clients into
-grouped convolutions.
+"""Client-fusion gate: the EXECUTION axis of the round-program
+builder (parallel/round_program.py) — which configurations may pack
+clients into grouped convolutions.
 
 ``cfg.mesh.client_fusion='fused'`` replaces the engine's
 ``vmap(client_round)`` model compute with one
@@ -8,7 +9,11 @@ grouped convolutions.
 per pass on the 16-64-channel north-star convs that pin MFU at 3.37%
 against the ~29% analytic roofline (docs/performance.md). The fused
 step is only a different LOWERING of the same per-client math, so it
-is gated to configurations where that equivalence is total:
+is gated to configurations where that equivalence is total
+(:func:`fusion_supported` is the execution-axis precondition the
+round-program cell validator consults; the one fused gate that is NOT
+here — commit x fused, a dispatch-axis interaction — lives with the
+rest of the composition matrix in ``round_program.validate_cell``):
 
 * the (arch, dataset, norm) triple has a fused module
   (models.define_fused_model — resnet-cifar family + cnn, norm='bn');

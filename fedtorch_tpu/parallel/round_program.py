@@ -1,0 +1,443 @@
+"""The round-program builder: one program family over three orthogonal
+axes (ROADMAP item 2 — "one round-program compiler").
+
+What used to be four hand-maintained dispatch paths in
+``parallel/federated.py`` (per-round device, ``run_rounds`` scan,
+streamed per-round, async commit) plus a pairwise gate matrix (stream
+refused ``run_rounds``, async refused fused/scan/shard-gather, fused
+refused multi-device) is composed here from three independent choices:
+
+* **data source** — ``'resident'`` (the full ``[C, n_max, ...]`` client
+  store lives in HBM and the round gathers its rows in-program) or
+  ``'feed'`` (the store stays host-resident and the program consumes a
+  host-packed, double-buffered feed — ``data/streaming.py``);
+* **dispatch** — ``'round'`` (one device call per communication round),
+  ``'scan'`` (R rounds under one ``lax.scan`` — the 47–266× dispatch
+  lever), or ``'commit'`` (the async plane's one-step buffered commit
+  over snapshot-ring inputs — the degenerate length-1 member of the
+  scan family, with per-job stale bases threaded through the commit
+  seam of ``_round_core``);
+* **client execution** — ``'vmap'`` (per-client model compute under
+  ``vmap``) or ``'fused'`` (one ``feature_group_count=k`` grouped conv
+  per layer — ``parallel/fusion.py``).
+
+Every cell funnels into the SAME ``FederatedTrainer._round_core``, so
+the robust-aggregation seam, chaos/guard masks, staleness weights and
+the host-recovery rebuild compose identically everywhere, and every
+legal cell holds the two engine-wide bars: bitwise parity of the
+per-round trajectory with the per-round device program, and exactly
+one trace per program (``tests/test_round_builder.py``).
+
+The gate matrix now contains only the cells that are genuinely
+impossible, each refused by ONE named ``ValueError`` from
+:func:`validate_cell` — there are no per-path gate checks left in
+``parallel/federated.py`` or ``async_plane/commit.py``:
+
+* ``commit × fused`` — the fused step packs all k clients into one
+  grouped conv against ONE shared server snapshot; buffered commits
+  train each client against its own dispatch-time version;
+* ``scan`` under ``sync_mode='async'`` — commits are host-scheduled
+  events (the event scheduler decides each commit's jobs), so there is
+  no R-commit program for one trace to scan;
+* algorithm/feature preconditions of an axis value (a ``feed`` source
+  cannot replay server-state-dependent participation; ``commit`` needs
+  a stale-snapshot-safe algorithm; ``fused`` needs the base local
+  step on one device) — named with the same reasons the old per-path
+  gates carried. The fused-execution preconditions are authored in
+  ``parallel/fusion.py`` (``fusion_supported``): at trainer
+  construction ``resolve_client_fusion`` raises them directly while
+  resolving the execution axis, and :func:`illegal_reason` consults
+  the same function for matrix enumeration — one rule set, two entry
+  points.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from fedtorch_tpu.algorithms.base import FedAlgorithm
+from fedtorch_tpu.data.batching import round_row_plan
+from fedtorch_tpu.parallel.fusion import fusion_supported
+
+# the three axes; tests and the chaos-suite matrix enumerate these so a
+# new axis value can never be silently absent from the coverage matrix
+SOURCES = ("resident", "feed")
+DISPATCHES = ("round", "scan", "commit")
+EXECUTIONS = ("vmap", "fused")
+
+# algorithms wired for stale-snapshot commits (the commit dispatch):
+# their hooks read only the per-job base params/aux the snapshot ring
+# threads, never cohort-global round structure
+ASYNC_ALGORITHMS = ("fedavg", "fedprox", "fedadam", "scaffold")
+
+# fold constant separating the commit program's per-dispatch training
+# streams from the round streams (chaos_salt 0x7FFFFFFD and the
+# augmentation parent 0x7FFFFFFF are taken; < 2^31 so fold_in accepts
+# it). Defined here — with the program family whose PRNG contract it
+# is — and re-exported by async_plane/scheduler.py.
+ASYNC_TRAIN_SALT = 0x7FFFFFF9
+
+
+class CommitJobs(NamedTuple):
+    """One commit's buffered updates as device inputs (all [m])."""
+    idx: jnp.ndarray        # int32 client ids (distinct)
+    version: jnp.ndarray    # int32 snapshot version each trained on
+    dispatch: jnp.ndarray   # int32 global dispatch counter (rng fold)
+    straggler: jnp.ndarray  # float32 {0,1} tail-delay dispatches
+
+
+def cell_name(source: str, dispatch: str, execution: str) -> str:
+    return f"({source} x {dispatch} x {execution})"
+
+
+def iter_cells():
+    """Every (source, dispatch, execution) combination — the coverage
+    matrix ``tests/test_round_builder.py`` parametrizes over."""
+    for source in SOURCES:
+        for dispatch in DISPATCHES:
+            for execution in EXECUTIONS:
+                yield source, dispatch, execution
+
+
+def illegal_reason(source: str, dispatch: str, execution: str, *, cfg,
+                   algorithm: FedAlgorithm, model, mesh_devices: int,
+                   k_online: int, gather_mode: str = "auto",
+                   has_val: bool = False, fused_resolved: bool = False):
+    """The reason a cell is unsupported, or None when it is legal.
+
+    ``gather_mode`` is the EXPLICIT (pre-resolution) mode: an
+    auto-resolved ``'shard'`` on the resident source is legal; an
+    explicitly pinned one on a packed-row program is not.
+    ``fused_resolved=True`` skips the fused-execution precondition
+    re-check (``fusion.fusion_supported`` builds a throwaway fused
+    module): a trainer whose ``resolve_client_fusion`` already
+    resolved 'fused' has proven it, with the same named reasons."""
+    if source not in SOURCES or dispatch not in DISPATCHES \
+            or execution not in EXECUTIONS:
+        raise ValueError(
+            f"unknown round-program cell {cell_name(source, dispatch, execution)}"
+            f" — axes are source={SOURCES}, dispatch={DISPATCHES}, "
+            f"execution={EXECUTIONS}")
+
+    # -- dispatch axis ---------------------------------------------------
+    if dispatch == "scan" and cfg.federated.sync_mode == "async":
+        return ("run_rounds scans ONE traced round program over R "
+                "rounds' inputs, but async commits are host-scheduled "
+                "events (each commit's jobs come from the event "
+                "scheduler), so no R-commit program exists to scan — "
+                "call run_round once per commit, or use "
+                "--sync_mode sync for the scan dispatch")
+    if dispatch == "commit":
+        alg_name = cfg.effective_algorithm
+        if alg_name not in ASYNC_ALGORITHMS:
+            return ("sync_mode='async' is unsupported for algorithm "
+                    f"{alg_name!r}: it is not wired for stale-snapshot "
+                    f"commits (supported: {', '.join(ASYNC_ALGORITHMS)};"
+                    " AFL/qFFL aggregate cohort-global losses, DRFA "
+                    "adds a dual phase and lambda participation, the "
+                    "personalized families need per-client val "
+                    "streams, and qsparse's tracking variate assumes "
+                    "the round's payload sum)")
+        if has_val or algorithm.needs_val_batch or cfg.federated.personal:
+            return ("per-client validation splits "
+                    "(cfg.federated.personal) are not buffered — "
+                    "sync_mode='async' commits carry no val stream")
+        if execution == "fused":
+            return ("client_fusion='fused' packs clients into one "
+                    "grouped conv against ONE shared server snapshot; "
+                    "buffered commits train each client against its "
+                    "own dispatch-time version — use the vmap "
+                    "execution or --sync_mode sync")
+        if gather_mode == "shard":
+            return ("gather_mode='shard' moves whole client shards; "
+                    "the commit program packs each buffered job's rows "
+                    "(the 'batch' plan) — use gather_mode 'auto' or "
+                    "'batch'")
+
+    # -- source axis -----------------------------------------------------
+    if source == "feed":
+        if algorithm.needs_full_loss:
+            return (f"{algorithm.name} evaluates each client's FULL "
+                    "local dataset every round (gather_mode='shard'); "
+                    "the host feed packs only the round's touched rows")
+        if (type(algorithm).participation
+                is not FedAlgorithm.participation
+                or type(algorithm).post_round_global
+                is not FedAlgorithm.post_round_global):
+            return (f"{algorithm.name} overrides participation/"
+                    "post_round_global with server-state-dependent "
+                    "logic the host feed builder cannot replay")
+        if algorithm.needs_val_batch or has_val:
+            return ("per-client validation splits "
+                    "(cfg.federated.personal) are not streamed yet")
+        if gather_mode == "shard":
+            return ("gather_mode='shard' moves whole client shards on "
+                    "device; the feed source packs rows host-side — "
+                    "use gather_mode 'auto' or 'batch'")
+
+    # -- execution axis --------------------------------------------------
+    if execution == "fused" and dispatch != "commit" \
+            and not fused_resolved:
+        fused, why = fusion_supported(cfg, model, algorithm,
+                                      mesh_devices, k_online)
+        if fused is None:
+            return f"mesh.client_fusion='fused' is unsupported: {why}"
+
+    # -- gather-mode precondition shared by every cell -------------------
+    if gather_mode == "batch" and algorithm.needs_full_loss:
+        return (f"{algorithm.name} requires gather_mode='shard' "
+                "(it evaluates the full local dataset each round)")
+    return None
+
+
+def validate_cell(source: str, dispatch: str, execution: str, **facts
+                  ) -> None:
+    """Raise the cell's ONE named ``ValueError`` when it is illegal.
+
+    This is the single error site for the whole composition matrix —
+    trainer construction validates the dispatches it serves
+    (round/commit) and ``run_rounds`` validates the scan cell at call
+    time, but the message always names the cell the same way."""
+    reason = illegal_reason(source, dispatch, execution, **facts)
+    if reason is not None:
+        raise ValueError(
+            "round-program cell "
+            f"{cell_name(source, dispatch, execution)} is unsupported "
+            f"here: {reason}")
+
+
+class RoundProgramBuilder:
+    """Builds the trainer's jittable programs per (dispatch) request,
+    with the source and execution axes read off the trainer (resolved
+    at construction). Program signatures by (source, dispatch):
+
+    ======== ========== ==============================================
+    source   dispatch   signature
+    ======== ========== ==============================================
+    resident round      ``fn(server, clients, data, val_data)``
+    feed     round      ``fn(server, clients, feed)``
+    resident scan-of-R  ``fn(server, clients, data, val_data)``
+    feed     scan-of-R  ``fn(server, clients, window)``  (leading [R])
+    resident commit     ``fn(server, clients, jobs, data)``
+    feed     commit     ``fn(server, clients, jobs, feed)``
+    ======== ========== ==============================================
+
+    Each ``build`` call returns a FRESH closure of the same code, so
+    the live jits and the uninstrumented cost-capture twins
+    (``telemetry/costs.py``) lower byte-identical HLO by construction.
+    """
+
+    def __init__(self, trainer):
+        self._t = trainer
+
+    @property
+    def source(self) -> str:
+        return "feed" if self._t.data_plane == "stream" else "resident"
+
+    @property
+    def execution(self) -> str:
+        return self._t.client_fusion
+
+    def validate(self, dispatch: str) -> None:
+        t = self._t
+        validate_cell(
+            self.source, dispatch, self.execution, cfg=t.cfg,
+            algorithm=t.algorithm, model=t.model,
+            mesh_devices=int(t.mesh.devices.size), k_online=t.k_online,
+            gather_mode=t.explicit_gather_mode, has_val=t.has_val,
+            # resolve_client_fusion already proved the fused-execution
+            # preconditions (same named reasons) — don't rebuild the
+            # fused module per validate call
+            fused_resolved=t.fused_module is not None)
+
+    def build(self, dispatch: str, *, scan_length: int = 1):
+        """Validate the cell, then return its program function."""
+        self.validate(dispatch)
+        if dispatch == "round":
+            return self._t.round_fn if self.source == "resident" \
+                else self._t.round_stream_fn
+        if dispatch == "scan":
+            return self._scan_program(scan_length)
+        return self._commit_program()
+
+    # -- scan dispatch ----------------------------------------------------
+    def _scan_program(self, num_rounds: int):
+        """R rounds under one ``lax.scan``: the host dispatches once
+        instead of once per round. On the resident source the scan
+        closes over the full data pytree in HBM (the seed fast path);
+        on the feed source it consumes an ``[R, k, K*B, ...]`` feed
+        WINDOW the producer packed while the device scans the previous
+        window — the scanned streamed program that finally gives the
+        stream plane the dispatch lever."""
+        t = self._t
+        if self.source == "resident":
+            def rounds_fn(server, clients, data, val_data):
+                def body(carry, _):
+                    s, c = carry
+                    s, c, m = t.round_fn(s, c, data, val_data)
+                    return (s, c), m
+
+                (s, c), ms = jax.lax.scan(
+                    body, (server, clients), None, length=num_rounds)
+                return s, c, ms
+        else:
+            def rounds_fn(server, clients, window):
+                def body(carry, feed):
+                    s, c = carry
+                    s, c, m = t.round_stream_fn(s, c, feed)
+                    return (s, c), m
+
+                (s, c), ms = jax.lax.scan(
+                    body, (server, clients), window, length=num_rounds)
+                return s, c, ms
+        return rounds_fn
+
+    # -- commit dispatch --------------------------------------------------
+    def _commit_program(self):
+        """The async plane's buffered commit as the one-step member of
+        the program family: gather each buffered job's rows (in-program
+        on the resident source, from the commit-keyed host feed on the
+        feed source), then run ``_round_core`` once through its commit
+        seam — per-job snapshot bases from the ring, staleness weights
+        composed into the aggregation weights, the ring rotated with
+        the new version."""
+        t = self._t
+        core = self._commit_core
+        K, B = t.local_steps, t.batch_size
+
+        def job_rngs(server, jobs):
+            # per-job training streams keyed by the GLOBAL dispatch
+            # counter, not the commit index — two dispatches of one
+            # client against different versions must not share a batch
+            # order
+            return jax.vmap(lambda d: jax.random.fold_in(
+                jax.random.fold_in(server.rng, ASYNC_TRAIN_SALT), d)
+            )(jobs.dispatch)
+
+        if self.source == "resident":
+            def commit_fn(server, clients, jobs: CommitJobs, data):
+                # gather each buffered job's rows in-program (the same
+                # round_row_plan the host feed packer replays, so the
+                # two commit sources are bitwise-identical)
+                rng_round = jax.random.fold_in(server.rng, server.round)
+                rngs = job_rngs(server, jobs)
+                idx = jobs.idx
+                on_sizes = jnp.take(data.sizes, idx)
+                rows = jax.vmap(lambda r, s: round_row_plan(
+                    r, s, data.x.shape[1], K * B))(rngs, on_sizes)
+                on_x = data.x[idx[:, None], rows]
+                on_y = data.y[idx[:, None], rows]
+                pre_x = data.x[idx[:, None], jnp.arange(B)[None, :]]
+                pre_y = data.y[idx[:, None], jnp.arange(B)[None, :]]
+                return core(server, clients, jobs, on_x, on_y, pre_x,
+                            pre_y, on_sizes, rngs, rng_round)
+        else:
+            def commit_fn(server, clients, jobs: CommitJobs, feed):
+                # the commit consumes a host-packed feed built one
+                # COMMIT ahead by the producer (keyed by commit
+                # version, not round index)
+                rng_round = jax.random.fold_in(server.rng, server.round)
+                rngs = job_rngs(server, jobs)
+                return core(server, clients, jobs, feed.x, feed.y,
+                            feed.pre_x, feed.pre_y, feed.sizes, rngs,
+                            rng_round)
+        return commit_fn
+
+    def _commit_core(self, server, clients, jobs: CommitJobs, on_x,
+                     on_y, pre_x, pre_y, on_sizes, rngs, rng_round):
+        """Unwrap the snapshot ring, gather each job's snapshot, and
+        re-dispatch ``_round_core`` through its commit seam; then
+        rotate the ring with the new version."""
+        # lazy import: async_plane imports parallel.federated, which
+        # imports this module — a module-level import here would close
+        # the cycle. Commit programs are only built by the async
+        # trainer, by which time async_plane is fully imported.
+        from fedtorch_tpu.async_plane.staleness import (
+            normalized_staleness_weights,
+        )
+        from fedtorch_tpu.robustness.chaos import (
+            draw_chaos_plan, no_chaos_plan,
+        )
+
+        t = self._t
+        fed = t.cfg.federated
+        alg_aux = server.aux["alg"]
+        ring = server.aux["ring"]
+        inner = server._replace(aux=alg_aux)
+        R = t.snapshot_ring
+        slot = jobs.version % R
+        take = lambda tr: jax.tree.map(
+            lambda x: jnp.take(x, slot, axis=0), tr)
+        base_params, base_aux = take(ring["params"]), take(ring["aux"])
+        stale = (server.round - jobs.version).astype(jnp.float32)
+        weight_scale = normalized_staleness_weights(
+            stale, fed.staleness_weight, fed.staleness_exponent)
+
+        # chaos composes: crash/NaN faults draw their usual per-commit
+        # folds; the straggler BUDGET cut is neutralized (stragglers
+        # already arrived late — cutting their steps too would double-
+        # apply the fault)
+        m = jobs.idx.shape[0]
+        flt = t.fault
+        if t.chaos_on:
+            plan = draw_chaos_plan(
+                jax.random.fold_in(rng_round, flt.chaos_salt), m, flt
+            )._replace(budget_scale=jnp.ones((m,)))
+        else:
+            plan = no_chaos_plan(m)
+
+        # no buffered val plane (a commit-cell gate): same placeholders
+        # as the feed source's round program
+        on_vx, on_vy = on_x[:, :1], on_y[:, :1]
+        on_vsizes = jnp.ones_like(on_sizes)
+        new_inner, new_clients, metrics = t._round_core(
+            inner, clients, jobs.idx, on_x, on_y, on_vx, on_vy,
+            on_sizes, on_vsizes, pre_x, pre_y, rng_round, rngs,
+            batch_mode=True, val_batch_mode=False,
+            base_params=base_params, base_aux=base_aux,
+            weight_scale=weight_scale, plan=plan)
+
+        # rotate the ring: the new commit version overwrites the oldest
+        # retained slot (new_inner.round == server.round + 1)
+        new_slot = new_inner.round % R
+        new_ring = {
+            "params": jax.tree.map(
+                lambda r, p: r.at[new_slot].set(p),
+                ring["params"], new_inner.params),
+            "aux": jax.tree.map(
+                lambda r, a: r.at[new_slot].set(a),
+                ring["aux"], new_inner.aux),
+        }
+        new_server = new_inner._replace(
+            aux={"alg": new_inner.aux, "ring": new_ring})
+        metrics = metrics._replace(
+            straggler_clients=jnp.sum(jobs.straggler),
+            staleness_mean=jnp.mean(stale))
+        return new_server, new_clients, metrics
+
+
+def resolve_gather_mode(gather_mode: str, *, algorithm: FedAlgorithm,
+                        data_plane: str, local_steps: int,
+                        batch_size: int, n_max: int) -> str:
+    """Resolve the explicit gather mode to 'shard' | 'batch'.
+
+    'batch' gathers only the K*B rows each online client will touch
+    this round (bounds cross-device movement when K*B < shard size);
+    'shard' moves whole client shards and indexes per step — required
+    when the algorithm reads the full local dataset (qFFL's full loss)
+    and cheaper when a round revisits the shard (K*B >= n_max). The
+    feed source always packs rows host-side, so its plan IS the
+    'batch' layout; refusals (explicit 'shard' on a packed-row
+    program, 'batch' under a full-loss algorithm) are
+    :func:`validate_cell`'s, not this function's."""
+    if gather_mode not in ("auto", "shard", "batch"):
+        raise ValueError(f"unknown gather_mode {gather_mode!r}")
+    if data_plane == "stream" and gather_mode == "auto":
+        return "batch"
+    if gather_mode == "auto":
+        return "shard" if (algorithm.needs_full_loss
+                           or local_steps * batch_size >= n_max) \
+            else "batch"
+    return gather_mode

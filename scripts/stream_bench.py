@@ -1,4 +1,5 @@
-"""Streaming-data-plane A/B: `data_plane='device'` vs `'stream'`.
+"""Streaming-data-plane A/B: `data_plane='device'` vs `'stream'`,
+plus the SCANNED-STREAM arm (ISSUE 11).
 
 Measures, per plane, on the north-star-shaped workload:
 
@@ -16,8 +17,23 @@ The acceptance bar (ISSUE 5): steady-state streamed round wall-time
 within 10% of device-resident when feed-build+transfer < round compute
 — i.e. the round-ahead prefetch actually hides the transfer.
 
+The scanned-stream arm (`run_rounds` on the stream plane — the
+round-program builder's feed x scan cell) times window sizes
+R in {1, 4, 16}: the producer packs an [R, k, K·B, ...] feed window
+while the device scans the previous one, so the stream plane gets the
+single-dispatch lever on top of the producer overlap. Each window row
+records per-round wall-time, the retrace count (must be 0 past the
+one warmup trace per R) and bitwise parity against the DEVICE plane's
+scan of the same round sequence; the headline ratios are
+`stream_scan_over_stream` (scan must beat per-round stream) and
+`stream_scan_over_device_walltime` (the stream-vs-device gap the scan
+lever exists to close).
+
 Writes STREAM_AB.json (STREAM_AB_PATH overrides, for the test smoke).
-STREAM_BENCH_SMOKE=1 shrinks the workload for CPU CI.
+STREAM_BENCH_SMOKE=1 shrinks the workload for CPU CI;
+STREAM_BENCH_ARCH overrides the model (e.g. `mlp` for a CPU-feasible
+full-population capture — the resnet20 default is the on-chip
+`stream` capture-step workload).
 
 Run:  python scripts/stream_bench.py
 """
@@ -57,16 +73,21 @@ from fedtorch_tpu.utils.tracing import (  # noqa: E402
 
 SMOKE = os.environ.get("STREAM_BENCH_SMOKE") == "1"
 # smoke: tiny MLP on MNIST-shaped synthetic rows; full: the north-star
-# resnet20/cifar10-shaped workload (bench.py's config, per-round mode)
+# resnet20/cifar10-shaped workload (bench.py's config, per-round mode).
+# STREAM_BENCH_ARCH overrides the full arch (a CPU-box full-population
+# capture uses `mlp`; the default stays the on-chip workload).
 NUM_CLIENTS = 16 if SMOKE else 100
 BATCH = 8 if SMOKE else 50
 K = 2 if SMOKE else 10
 SPC = 64 if SMOKE else 250
 ROUNDS = 3 if SMOKE else 20
 ONLINE = 0.25 if SMOKE else 0.1
-ARCH = "mlp" if SMOKE else "resnet20"
-DATASET = "mnist" if SMOKE else "cifar10"
-FEAT_SHAPE = (784,) if SMOKE else (32, 32, 3)
+ARCH = "mlp" if SMOKE else os.environ.get("STREAM_BENCH_ARCH",
+                                          "resnet20")
+DATASET = "mnist" if (SMOKE or ARCH == "mlp") else "cifar10"
+FEAT_SHAPE = (784,) if (SMOKE or ARCH == "mlp") else (32, 32, 3)
+# scanned-stream window sizes (the feed x scan cell)
+SCAN_WINDOWS = (1, 4) if SMOKE else (1, 4, 16)
 
 
 def log(*a):
@@ -161,8 +182,64 @@ def main():
             f"{residency['total_bytes']/2**20:7.1f} MB live on device, "
             f"{retraces} retraces")
         del tr
+    # -- scanned-stream arm (the builder's feed x scan cell) -----------
+    scan_rows = {}
+    feed_mb = out["modes"]["stream"]["h2d_mb_per_round"]
+    for R in SCAN_WINDOWS:
+        gc.collect()
+        tr = build("stream")
+        calls = max(1, ROUNDS // R)
+        server, clients = tr.init_state(jax.random.key(0))
+        server, clients, _ = tr.run_rounds(server, clients, R)
+        sync(server.params)  # compile + first window drained
+        with RecompilationSentinel() as sentinel:
+            t0 = time.perf_counter()
+            for _ in range(calls):
+                server, clients, _ = tr.run_rounds(server, clients, R)
+            sync(server.params)
+            dt = (time.perf_counter() - t0) / (calls * R)
+        retraces = sum(sentinel.counts.values())
+        params = jax.device_get(server.params)
+        tr.invalidate_stream()
+        del tr
+        gc.collect()
+        # the device reference scans the SAME round sequence — the
+        # parity bar is bitwise against the resident scan program
+        tr = build("device")
+        server, clients = tr.init_state(jax.random.key(0))
+        for _ in range(calls + 1):
+            server, clients, _ = tr.run_rounds(server, clients, R)
+        ref = jax.device_get(server.params)
+        del tr
+        # lint: disable=FTL001 — operands already fetched to host
+        max_diff = max(
+            float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+            for a, b in zip(jax.tree.leaves(params),
+                            jax.tree.leaves(ref)))
+        scan_rows[f"R={R}"] = {
+            "ms_per_round": round(dt * 1e3, 2),
+            "rounds_timed": calls * R,
+            "retraces_during_timed_rounds": retraces,
+            "window_h2d_mb": round(feed_mb * R, 3),
+            "parity_bitwise_vs_device_scan": max_diff == 0.0,
+            "parity_max_abs_diff": max_diff,
+        }
+        log(f"stream+scan R={R:3d}: {dt*1e3:8.2f} ms/round, "
+            f"{retraces} retraces, max|Δ| vs device scan {max_diff}")
     d, s = (out["modes"]["device"]["ms_per_round"],
             out["modes"]["stream"]["ms_per_round"])
+    best_R = min(scan_rows, key=lambda k: scan_rows[k]["ms_per_round"])
+    best = scan_rows[best_R]["ms_per_round"]
+    out["scanned_stream"] = {
+        "windows": scan_rows,
+        "best_window": best_R,
+        "best_ms_per_round": best,
+        # scan must beat the per-round stream dispatch...
+        "stream_scan_over_stream": round(best / s, 3),
+        # ...and this is the stream-vs-device gap the lever closes
+        "stream_scan_over_device_walltime": round(best / d, 3),
+        "gap_closed_to_leq_1x": bool(best <= d),
+    }
     out["stream_over_device_walltime"] = round(s / d, 3)
     out["overlap_within_10pct"] = bool(s <= 1.10 * d)
     # finals hold HOST numpy (device_get in timed()) — no device sync

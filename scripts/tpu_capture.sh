@@ -26,6 +26,12 @@
 #                         sign_flip must break plain mean by >5 pts
 #                         while >=1 robust rule holds within 5 —
 #                         docs/robustness.md threat-model table)
+#   builder-matrix   scripts/chaos_suite.py --builder-matrix
+#                        -> BUILDER_MATRIX.json (round-program-builder
+#                         smoke: scanned device, scanned streamed and
+#                         feed-commit cells under chaos + guards, each
+#                         trace-once and bitwise vs its reference —
+#                         docs/performance.md "Round-program builder")
 #   host-chaos       scripts/chaos_suite.py --host-fault-matrix
 #                        -> HOST_CHAOS_AB.json (host-plane fault
 #                         drill: every HOST_FAULT_SEAMS seam injected
@@ -77,9 +83,9 @@ TRIES="${TPU_CAPTURE_WAIT_TRIES:-90}"   # ~6 h of patience by default
 # mfu leads: round 6 is the utilization round — the fused-vs-base A/B
 # and the first-ever on-chip traces are the highest-value capture if
 # the relay wedges mid-list
-DEFAULT_STEPS="mfu stream async attack host-chaos telemetry \
-bench-streaming bench-dispatch bench-unroll bench zoo pallas \
-flash-train vmap baseline"
+DEFAULT_STEPS="mfu stream builder-matrix async attack host-chaos \
+telemetry bench-streaming bench-dispatch bench-unroll bench zoo \
+pallas flash-train vmap baseline"
 STEPS="${*:-$DEFAULT_STEPS}"
 
 echo "[tpu_capture] waiting for the relay (up to ${TRIES}x120s probes)"
@@ -101,6 +107,9 @@ for step in $STEPS; do
         attack)         run python scripts/chaos_suite.py \
                             --attack-matrix --rounds 25 \
                             --attack-out ATTACK_AB.json ;;
+        builder-matrix) run python scripts/chaos_suite.py \
+                            --builder-matrix --rounds 8 \
+                            --builder-out BUILDER_MATRIX.json ;;
         host-chaos)     run python scripts/chaos_suite.py \
                             --host-fault-matrix --rounds 12 \
                             --host-out HOST_CHAOS_AB.json ;;

@@ -33,6 +33,24 @@ def test_kill_drill_lifecycle(tmp_path):
 
 
 @pytest.mark.slow
+def test_builder_matrix_acceptance():
+    """ISSUE 11 CI smoke: the three representative round-program
+    cells (resident scan, scanned stream, feed commit) under the
+    chaos schedule with guards ON — each completes with finite
+    params, traces exactly once, and matches its reference program
+    bitwise (the per-round device program / resident commit)."""
+    from chaos_suite import run_builder_matrix
+    report = run_builder_matrix(rounds=6, smoke=True)
+    assert set(report["cells"]) == {
+        "(resident x scan x vmap)", "(feed x scan x vmap)",
+        "(feed x commit x vmap)"}
+    for name, cell in report["cells"].items():
+        assert cell["retraces"] == 0, name
+        assert cell["finite"], name
+        assert cell["bitwise_vs_reference"], name
+
+
+@pytest.mark.slow
 def test_attack_matrix_acceptance():
     """ISSUE 9 acceptance: under the fixed 25% sign_flip byzantine
     cohort (scale 3, guards on — the attack passes them), plain mean

@@ -36,3 +36,15 @@ def test_stream_bench_smoke(tmp_path):
     assert report["modes"]["device"]["h2d_mb_per_round"] == 0
     # the two planes trained the same model
     assert report["parity_bitwise"] is True
+    # the scanned-stream arm (feed x scan, ISSUE 11): every window row
+    # must be retrace-free and bitwise-identical to the device plane's
+    # scan of the same round sequence, and the headline ratios present
+    scan = report["scanned_stream"]
+    assert set(scan["windows"]) == {"R=1", "R=4"}  # smoke windows
+    for row in scan["windows"].values():
+        assert row["retraces_during_timed_rounds"] == 0
+        assert row["parity_bitwise_vs_device_scan"] is True
+        assert row["ms_per_round"] > 0
+    assert scan["best_window"] in scan["windows"]
+    assert scan["stream_scan_over_stream"] > 0
+    assert scan["stream_scan_over_device_walltime"] > 0

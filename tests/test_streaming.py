@@ -322,12 +322,28 @@ def test_supervisor_rollback_resyncs_stream(monkeypatch):
 
 
 # -- gates / config / CLI ----------------------------------------------------
-def test_run_rounds_refused_on_stream_plane():
-    t = build("stream")
-    server, clients = t.init_state(jax.random.key(0))
-    with pytest.raises(RuntimeError, match="run_rounds"):
-        t.run_rounds(server, clients, 2)
-    t.invalidate_stream()
+def test_run_rounds_scans_on_stream_plane():
+    """The scanned streamed program (parallel/round_program.py): the
+    stream plane serves run_rounds — the producer packs an [R, ...]
+    feed window — and the trajectory matches per-round device rounds
+    BITWISE. Construction must NOT pre-refuse the scan cell (the gate,
+    when one applies, fires at the run_rounds call — satellite of
+    ISSUE 11); mixed dispatch granularity re-syncs the producer."""
+    t_dev = build("device")
+    t_str = build("stream")
+    s1, c1 = t_dev.init_state(jax.random.key(5))
+    s2, c2 = t_str.init_state(jax.random.key(5))
+    for _ in range(4):
+        s1, c1, m1 = t_dev.run_round(s1, c1)
+    # per-round then scanned: the granularity switch re-syncs the
+    # producer from live device state (window 1 -> window 3)
+    s2, c2, _ = t_str.run_round(s2, c2)
+    s2, c2, ms = t_str.run_rounds(s2, c2, 3)
+    assert_trees_equal((s1.params, s1.aux, c1), (s2.params, s2.aux, c2))
+    # stacked metrics: the last scanned round's row equals the device
+    # plane's final per-round metrics
+    assert_trees_equal(jax.tree.map(lambda a: a[-1], ms), m1)
+    t_str.invalidate_stream()
 
 
 def test_explicit_shard_gather_refused():
