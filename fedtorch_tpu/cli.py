@@ -1168,6 +1168,15 @@ def main(argv=None):
         # initializes jax
         from fedtorch_tpu.lint.cli import main as lint_main
         return lint_main(argv[1:])
+    if argv and argv[0] == "audit":
+        # `fedtorch-tpu audit [...]` — the program-level + registry-
+        # drift audit (docs/static_analysis.md "The program audit"):
+        # abstractly lowers every legal round-program builder cell and
+        # checks the HLO/jaxpr (FTP rules), then cross-checks the five
+        # hand-maintained registries (FTC rules). Initializes jax
+        # (CPU is fine); --registry-only stays stdlib.
+        from fedtorch_tpu.lint.cli import main as lint_main
+        return lint_main(["--audit"] + argv[1:])
     if argv and argv[0] == "report":
         # `fedtorch-tpu report <run_dir>` — summarize a run dir's
         # telemetry (docs/observability.md); stdlib-only, never

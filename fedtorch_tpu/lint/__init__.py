@@ -10,7 +10,11 @@ static rules here approximate what the runtime recompilation sentinel
 dynamically; the two gates ship together (scripts/lint_suite.py).
 
 Stdlib-only: importing this package must never pull in jax, so the
-gate runs in any CI lane.
+gate runs in any CI lane. (The program-level audit —
+``lint.program_audit``, which lowers every round-program builder cell
+and checks the HLO/jaxpr — keeps its jax imports inside functions for
+the same reason; the registry-drift checker ``lint.registry_audit``
+is pure stdlib.)
 """
 from fedtorch_tpu.lint.analyzer import (  # noqa: F401
     ModuleAnalysis, analyze_paths, analyze_source,
@@ -18,4 +22,9 @@ from fedtorch_tpu.lint.analyzer import (  # noqa: F401
 from fedtorch_tpu.lint.findings import (  # noqa: F401
     Finding, diff_against_baseline, load_baseline, save_baseline,
 )
-from fedtorch_tpu.lint.rules import RULES  # noqa: F401
+from fedtorch_tpu.lint.registry_audit import (  # noqa: F401
+    audit_registries,
+)
+from fedtorch_tpu.lint.rules import (  # noqa: F401
+    ALL_RULES, PROGRAM_RULES, REGISTRY_RULES, RULES,
+)

@@ -196,6 +196,7 @@ class ModuleAnalysis:
         for fn in self.functions:
             if not isinstance(fn, ast.Lambda):
                 self._fn_by_name.setdefault(fn.name, []).append(fn)
+        self._fn_by_binding = self._collect_fn_bindings()
         self.traced: Set[ast.AST] = set()
         # (fn_node, has_donate, site_node): site is where a
         # donate_argnums= would be written — the decorator/jit call
@@ -239,16 +240,95 @@ class ModuleAnalysis:
                         out.add(pos[n.value])
         return out
 
+    def _collect_fn_bindings(self) -> Dict[str, List[ast.AST]]:
+        """Names bound to function values by ASSIGNMENT — the
+        local-closure idiom ``lax.scan``/``while_loop`` bodies are
+        built with (``round_program.py``): ``step = _make_body(t)``,
+        ``body = lambda s: ...``, ``fn = a_body if flag else b_body``.
+        Chased to a fixpoint so chains of rebindings resolve. Without
+        this map, a closure bound to a local before the tracing call
+        was invisible to traced-context discovery (the gap pinned by
+        tests/test_lint_analyzer.py's scan-closure fixtures)."""
+        bindings: Dict[str, List[ast.AST]] = {}
+
+        def refs_of(expr: ast.AST) -> List[ast.AST]:
+            """Function nodes a deliberately-function-valued RHS
+            denotes. Deliberate forms only — a general result-of-call
+            binding would mark every helper traced and cascade false
+            positives through the intra-module call graph."""
+            if isinstance(expr, ast.Lambda):
+                return [expr]
+            if isinstance(expr, ast.Name):
+                return list(self._fn_by_name.get(expr.id, [])) \
+                    + list(bindings.get(expr.id, []))
+            if isinstance(expr, ast.Attribute):
+                return list(self._fn_by_name.get(expr.attr, []))
+            if isinstance(expr, ast.IfExp):
+                return refs_of(expr.body) + refs_of(expr.orelse)
+            if isinstance(expr, ast.Call) and \
+                    isinstance(expr.func, ast.Name):
+                # closure factory: `step = _make_body(t)` resolves to
+                # the function(s) the factory RETURNS — not the
+                # factory itself, so helpers that merely return call
+                # results don't get wrongly marked traced
+                out: List[ast.AST] = []
+                for cand in self._fn_by_name.get(expr.func.id, []):
+                    out.extend(self._returned_fns(cand))
+                return out
+            return []
+
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(self.tree):
+                if not isinstance(node, ast.Assign) \
+                        or len(node.targets) != 1 \
+                        or not isinstance(node.targets[0], ast.Name):
+                    continue
+                refs = refs_of(node.value)
+                if not refs:
+                    continue
+                known = bindings.setdefault(node.targets[0].id, [])
+                for r in refs:
+                    if r not in known:
+                        known.append(r)
+                        changed = True
+        return bindings
+
+    def _returned_fns(self, fndef: ast.AST) -> List[ast.AST]:
+        """Function nodes ``fndef`` returns (lambdas, nested-def
+        names, conditional expressions of either) — what a closure
+        factory hands its caller."""
+        out: List[ast.AST] = []
+
+        def resolve(expr: ast.AST) -> None:
+            if isinstance(expr, ast.Lambda):
+                out.append(expr)
+            elif isinstance(expr, ast.Name):
+                out.extend(self._fn_by_name.get(expr.id, []))
+            elif isinstance(expr, ast.IfExp):
+                resolve(expr.body)
+                resolve(expr.orelse)
+
+        for sub in ast.walk(fndef):
+            if isinstance(sub, ast.Return) and sub.value is not None \
+                    and _enclosing_function(sub) is fndef:
+                resolve(sub.value)
+        return out
+
     def _resolve_fn_refs(self, node: ast.AST) -> List[ast.AST]:
         """Function defs referenced by name (or trailing attribute —
         ``self.round_fn`` resolves to the method ``round_fn``) anywhere
-        inside ``node``, plus inline lambdas/defs."""
+        inside ``node``, plus inline lambdas/defs and names BOUND to
+        function values by assignment (``_collect_fn_bindings`` — the
+        closure-factory / name-assigned-lambda idioms)."""
         out: List[ast.AST] = []
         for sub in ast.walk(node):
             if isinstance(sub, ast.Lambda):
                 out.append(sub)
             elif isinstance(sub, ast.Name):
                 out.extend(self._fn_by_name.get(sub.id, []))
+                out.extend(self._fn_by_binding.get(sub.id, []))
             elif isinstance(sub, ast.Attribute):
                 out.extend(self._fn_by_name.get(sub.attr, []))
         return out
@@ -369,7 +449,10 @@ class ModuleAnalysis:
         forward pass), plus — when ``fn`` is traced — its non-static
         parameters."""
         out: Set[str] = set()
-        if fn in self.traced and not isinstance(fn, ast.Lambda):
+        if fn in self.traced:
+            # lambdas share ast.arguments with defs, so traced
+            # name-assigned lambda bodies get device-flavored params
+            # too (the while_loop/scan local-closure idiom)
             static = self._static_params.get(fn, set())
             for a in (fn.args.posonlyargs + fn.args.args
                       + fn.args.kwonlyargs):

@@ -10,6 +10,16 @@ gate ``scripts/lint_suite.py`` and ``tests/test_lint_suite.py`` wrap.
     python -m fedtorch_tpu.lint --write-baseline  # accept current state
     python -m fedtorch_tpu.lint --explain       # rule catalog
     python -m fedtorch_tpu.lint path/to/file.py # specific targets
+
+``--audit`` (also reachable as ``fedtorch-tpu audit``) runs the OTHER
+two halves instead of the AST gate: the registry-drift checker
+(``registry_audit``, stdlib-only) and the program-level audit
+(``program_audit`` — abstractly lowers every legal round-program
+builder cell on the active backend and checks the HLO/jaxpr; needs
+jax). ``--registry-only`` skips the lowering half for jax-free lanes;
+``--write-baseline`` under ``--audit`` re-pins
+``lint/program_baseline.json``; ``--out FILE`` writes the audit
+report document (the ``audit`` step of scripts/tpu_capture.sh).
 """
 from __future__ import annotations
 
@@ -25,7 +35,11 @@ from fedtorch_tpu.lint.findings import (
 )
 from fedtorch_tpu.lint.rules import explain
 
-DEFAULT_TARGETS = ("fedtorch_tpu", "scripts", "bench.py", "run_tpu.py")
+# "tools" is walked when a top-level tools/ dir exists (none today —
+# package tools live under fedtorch_tpu/tools, which the package walk
+# covers); listing it keeps a future top-level tools/ inside the gate
+DEFAULT_TARGETS = ("fedtorch_tpu", "scripts", "tools", "bench.py",
+                   "run_tpu.py")
 DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(
     __file__)), "baseline.json")
 
@@ -55,7 +69,60 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--format", choices=("text", "json"), default="text")
     p.add_argument("--explain", action="store_true",
                    help="print the rule catalog and exit")
+    p.add_argument("--audit", action="store_true",
+                   help="run the program-level + registry-drift audit "
+                        "(FTP/FTC rules) instead of the AST gate")
+    p.add_argument("--registry-only", action="store_true",
+                   help="with --audit: only the stdlib registry-drift "
+                        "half (no jax, no program lowering)")
+    p.add_argument("--out", default=None,
+                   help="with --audit: write the report document "
+                        "(JSON) to this path")
     return p
+
+
+def run_audit(args) -> int:
+    """The ``fedtorch-tpu audit`` gate: registry drift (stdlib) +
+    program-level HLO/jaxpr checks over every builder cell."""
+    import json as _json
+
+    from fedtorch_tpu.lint.registry_audit import audit_registries
+
+    root = args.root or repo_root()
+    reg_findings = audit_registries(root)
+    report = {"registry_findings": len(reg_findings)}
+    findings = list(reg_findings)
+    if not args.registry_only:
+        from fedtorch_tpu.lint.program_audit import (
+            PROGRAM_BASELINE, audit_programs,
+        )
+        baseline = args.baseline if args.baseline != DEFAULT_BASELINE \
+            else PROGRAM_BASELINE
+        prog_new, prog_report = audit_programs(
+            baseline_path=baseline,
+            write_baseline=args.write_baseline,
+            log=(lambda *_: None) if args.format == "json" else print)
+        findings += prog_new
+        report.update(prog_report)
+    if args.format == "json":
+        # stdout stays one parseable document — findings ride inside it
+        print(_json.dumps({
+            "new": [f.__dict__ for f in findings], **report}, indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        print(f"fedtorch-tpu audit: {len(findings)} NEW finding(s) "
+              f"({len(reg_findings)} registry, "
+              f"{len(findings) - len(reg_findings)} program; "
+              f"wall {report.get('wall_s', 0)}s)")
+    if args.out:
+        report_doc = dict(report)
+        report_doc["findings"] = [f.__dict__ for f in findings]
+        with open(args.out, "w") as fh:
+            _json.dump(report_doc, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        print(f"audit report written to {args.out}")
+    return 1 if findings else 0
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -63,6 +130,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.explain:
         print(explain())
         return 0
+    if args.audit:
+        return run_audit(args)
     root = args.root or repo_root()
     targets = args.targets or list(DEFAULT_TARGETS)
     findings = analyze_paths(root, targets)

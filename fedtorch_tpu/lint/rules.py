@@ -1,9 +1,15 @@
 """Rule registry: ids, rationale, and fix hints.
 
-The detection logic lives in ``analyzer.py``; this module is the
-single place a rule's id, one-line description, and default fix hint
-are defined, so the CLI ``--explain`` output, the docs, and the
-analyzer messages cannot drift apart.
+The detection logic lives in ``analyzer.py`` (FTL: source-level AST
+hazards), ``program_audit.py`` (FTP: checks over the LOWERED
+jaxpr/HLO of every round-program builder cell) and
+``registry_audit.py`` (FTC: drift between hand-maintained registries
+and their emit sites/docs); this module is the single place a rule's
+id, one-line description, and default fix hint are defined, so the
+CLI ``--explain`` output, the docs tables (rendered by
+:func:`markdown_table`, pinned against docs/static_analysis.md by
+tests/test_registry_audit.py), and the checker messages cannot drift
+apart.
 
 Why each rule exists on TPU (long form: docs/static_analysis.md):
 
@@ -63,14 +69,113 @@ RULES: Dict[str, Rule] = {r.rule_id: r for r in [
 ]}
 
 
+# Program-level rules: checked against the LOWERED StableHLO/jaxpr of
+# every legal round-program builder cell (lint/program_audit.py) —
+# the invariants the repo leans on (bf16 stays bf16, donated buffers
+# alias, one collective per round, no host chatter, no baked-in data)
+# live in the XLA artifact, where nothing else checks them before
+# silicon time.
+PROGRAM_RULES: Dict[str, Rule] = {r.rule_id: r for r in [
+    Rule("FTP001",
+         "unintended dtype promotion in the lowered program "
+         "(any f64; f32 matmul/conv inside a bf16-configured program)",
+         "find the widening op (np float64 literal, python float "
+         "promotion, missing astype) and pin the intended dtype; "
+         "bf16 programs must feed bf16 into every dot/convolution"),
+    Rule("FTP002",
+         "host transfer inside the program body "
+         "(infeed/outfeed/send/recv/host callback custom_call)",
+         "remove the jax.debug.*/io_callback/device round-trip from "
+         "the traced program; batch host reads at round boundaries "
+         "via the one sanctioned device_get"),
+    Rule("FTP003",
+         "ineffective donation: donated args that never alias an "
+         "output buffer",
+         "make the donated state flow to a same-shape/dtype output "
+         "(or stop donating it) — an unaliased donation still frees "
+         "late and the program holds 2x HBM for that buffer"),
+    Rule("FTP004",
+         "collective count exceeds the cell's per-round budget",
+         "the round program owns ONE aggregation collective per "
+         "round (scaled by scan length); fold extra psums/gathers "
+         "into it or hoist them out of the program"),
+    Rule("FTP005",
+         "large constant baked into the lowered program",
+         "pass the array as an argument (or close over device data "
+         "via the data pytree) instead of capturing a host constant "
+         "— baked literals bloat the executable and re-upload per "
+         "compile"),
+    Rule("FTP006",
+         "peak-HBM watermark regression vs lint/program_baseline.json",
+         "justify the growth and re-pin with `fedtorch-tpu audit "
+         "--write-baseline`, or find the new live buffer "
+         "(memory_analysis temp/argument bytes name the side)"),
+]}
+
+# Registry-drift rules: the five hand-maintained catalogs and the
+# sources they must stay in lockstep with (lint/registry_audit.py).
+REGISTRY_RULES: Dict[str, Rule] = {r.rule_id: r for r in [
+    Rule("FTC001",
+         "metrics-row field drift: emitted vs telemetry.schema "
+         "catalog vs docs/observability.md",
+         "every emitted row field must be cataloged in "
+         "METRICS_REQUIRED/METRICS_OPTIONAL, every cataloged field "
+         "emitted somewhere (or listed in RESERVED_METRIC_FIELDS) "
+         "and named in the docs metric-catalog tables"),
+    Rule("FTC002",
+         "event-name drift: emitted telemetry events vs the "
+         "docs/observability.md event list",
+         "add the new event name to the events paragraph of "
+         "docs/observability.md (or delete the dead emit site)"),
+    Rule("FTC003",
+         "host-fault-seam drift: config.HOST_FAULT_SEAMS vs the "
+         "chaos drill matrix, CLI help, and docs/robustness.md",
+         "a new seam needs all four: the config tuple, the "
+         "--host_fault_seams help text, a drill cell "
+         "(chaos_suite.py --host-fault-matrix) and a row in the "
+         "robustness.md seam table"),
+    Rule("FTC004",
+         "config<->CLI drift: argparse dests vs the args.* fields "
+         "args_to_config consumes",
+         "wire the flag through args_to_config (or drop it); a "
+         "parsed-but-unconsumed flag silently ignores user intent"),
+    Rule("FTC005",
+         "builder-cell matrix drift: round_program axis tuples vs "
+         "the test matrix's ILLEGAL cells and refusal snapshots",
+         "a new axis value/illegal cell needs the axis tuple, an "
+         "entry in tests/test_round_builder.py's matrix, and a "
+         "refusal-message snapshot test"),
+]}
+
+ALL_RULES: Dict[str, Rule] = {**RULES, **PROGRAM_RULES, **REGISTRY_RULES}
+
+
 def hint_for(rule_id: str) -> str:
-    return RULES[rule_id].hint
+    return ALL_RULES[rule_id].hint
+
+
+def markdown_table(rules: Dict[str, Rule]) -> str:
+    """The docs table for a rule family — docs/static_analysis.md
+    embeds this output verbatim (pinned by
+    tests/test_registry_audit.py), so the table cannot drift from the
+    registry."""
+    lines = ["| id | finding | fix |", "|---|---|---|"]
+    for r in rules.values():
+        lines.append(f"| `{r.rule_id}` | {r.title} | {r.hint} |")
+    return "\n".join(lines)
 
 
 def explain() -> str:
     lines = ["fedtorch_tpu.lint rules (details: docs/static_analysis.md)",
              ""]
-    for r in RULES.values():
-        lines.append(f"  {r.rule_id}  {r.title}")
-        lines.append(f"          fix: {r.hint}")
-    return "\n".join(lines)
+    for title, family in (("source (AST analyzer)", RULES),
+                          ("lowered program (fedtorch-tpu audit)",
+                           PROGRAM_RULES),
+                          ("registry drift (fedtorch-tpu audit)",
+                           REGISTRY_RULES)):
+        lines.append(f"-- {title} --")
+        for r in family.values():
+            lines.append(f"  {r.rule_id}  {r.title}")
+            lines.append(f"          fix: {r.hint}")
+        lines.append("")
+    return "\n".join(lines).rstrip()

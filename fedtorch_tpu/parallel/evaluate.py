@@ -123,8 +123,8 @@ def evaluate(model: ModelDef, params, x: np.ndarray, y: np.ndarray,
 
     key = (model.module, model.is_regression, model.is_recurrent)
     if key not in _EVAL_CACHE:
-        # params is the live server model, reused every round
-        # lint: disable=FTL004 — live server params, donation unsafe
+        # params is the live server model, reused every round —
+        # donation would be unsafe here
         _EVAL_CACHE[key] = jax.jit(
             instrument_trace("evaluate.run", _eval_run_fn(model)))
     return _EVAL_CACHE[key](params, bx, by, bm)
@@ -291,7 +291,7 @@ def evaluate_per_class(model: ModelDef, params, x: np.ndarray,
                 (bx, by, bm))
             return c_sum / jnp.maximum(t_sum, 1.0), t_sum
 
-        # lint: disable=FTL004 — live server params, donation unsafe
+        # params is the live server model: donation unsafe
         _PER_CLASS_CACHE[key] = jax.jit(
             instrument_trace("evaluate.per_class", run))
     return _PER_CLASS_CACHE[key](params, bx, by, bm)

@@ -101,6 +101,42 @@ def iter_cells():
                 yield source, dispatch, execution
 
 
+def cell_build_facts(source: str, dispatch: str, execution: str) -> dict:
+    """How a trainer serving this cell is configured — the config
+    axes a cell name maps onto. The enumeration hook the program
+    auditor (``lint/program_audit.py``) and future matrix drivers
+    build trainers from, so cell-to-config mapping lives with the
+    axes instead of being re-derived per caller."""
+    if source not in SOURCES or dispatch not in DISPATCHES \
+            or execution not in EXECUTIONS:
+        raise ValueError(
+            f"unknown round-program cell "
+            f"{cell_name(source, dispatch, execution)}")
+    return {
+        "data_plane": "stream" if source == "feed" else "device",
+        "sync_mode": "async" if dispatch == "commit" else "sync",
+        "client_fusion": execution,
+    }
+
+
+def collective_budget(source: str, dispatch: str, execution: str, *,
+                      mesh_devices: int, num_rounds: int = 1) -> int:
+    """Max cross-device collectives the cell's lowered program may
+    carry — the FTP004 budget (``lint/program_audit.py``).
+
+    Every cell funnels into the one ``_round_core`` aggregation, so
+    the budget is ONE collective per round (the masked psum-style
+    weighted sum), scaled by the scan length; single-device lowerings
+    carry none (XLA folds the degenerate collective away). A program
+    exceeding this has grown a second synchronization point — the
+    exact regression class the one-collective-per-round design
+    exists to prevent."""
+    if mesh_devices <= 1:
+        return 0
+    rounds = num_rounds if dispatch == "scan" else 1
+    return rounds
+
+
 def illegal_reason(source: str, dispatch: str, execution: str, *, cfg,
                    algorithm: FedAlgorithm, model, mesh_devices: int,
                    k_online: int, gather_mode: str = "auto",

@@ -106,6 +106,16 @@ METRICS_OPTIONAL = {
                       "(live_buffer_summary — metadata walk, no sync)",
 }
 
+def all_metric_fields() -> frozenset:
+    """Every cataloged metrics-row field name (required + optional) —
+    the single catalog surface consumers key on. The registry-drift
+    checker (``fedtorch_tpu.lint.registry_audit``, FTC001) gates this
+    set against the actual emit sites and the docs/observability.md
+    tables in tier-1, so a field cannot exist in only one of the
+    three places."""
+    return frozenset(METRICS_REQUIRED) | frozenset(METRICS_OPTIONAL)
+
+
 HEALTH_INTENTS = (
     "starting",    # process up, loop not yet entered
     "running",     # making round progress

@@ -337,7 +337,7 @@ def run_builder_matrix(rounds: int = 8, smoke: bool = False,
                                                            dispatch)
         rp, rm = (ref_commit_params, ref_commit_metrics) \
             if dispatch == "commit" else (ref_params, ref_metrics)
-        # lint: disable=FTL001 — operands already fetched to host
+        # operands were already fetched to host above
         max_diff = max(
             float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
             for a, b in zip(jax.tree.leaves(params),

@@ -1,9 +1,11 @@
 #!/usr/bin/env python
 """One lint gate: ruff (generic style) + fedtorch_tpu.lint (TPU
-tracing hazards vs the checked-in baseline).
+tracing hazards vs the checked-in baseline) + the registry-drift
+checker (FTC rules: metrics catalog, event names, fault seams,
+config<->CLI surface, builder-cell matrix — lint/registry_audit.py).
 
-Exit status is non-zero when either half reports NEW findings, so CI
-and the tier-1 wrapper (tests/test_lint_suite.py) enforce both with a
+Exit status is non-zero when any half reports NEW findings, so CI
+and the tier-1 wrapper (tests/test_lint_suite.py) enforce all with a
 single entry point:
 
     python scripts/lint_suite.py            # the gate
@@ -12,8 +14,10 @@ single entry point:
 ruff is config-gated: the container this repo grows in does not ship
 it, so when the executable is absent the generic half is SKIPPED with
 a notice (the pyproject [tool.ruff] config is still the contract any
-ruff-equipped environment enforces).  The custom analyzer is
-stdlib-only and always runs.
+ruff-equipped environment enforces).  The custom analyzer and the
+registry checker are stdlib-only and always run; the program-level
+HLO audit (which needs jax) lives behind `fedtorch-tpu audit` and
+its own tier-1 tests instead (docs/static_analysis.md).
 """
 from __future__ import annotations
 
@@ -42,6 +46,17 @@ def run_tracing_lint(argv=None) -> int:
     return lint_main(argv or [])
 
 
+def run_registry_audit() -> int:
+    """The FTC registry-drift half (stdlib-only, no baseline: drift
+    is fixed at the registry or the emit site, never accepted)."""
+    sys.path.insert(0, REPO)
+    from fedtorch_tpu.lint.registry_audit import audit_registries
+    findings = audit_registries(REPO)
+    for f in findings:
+        print(f.render())
+    return 1 if findings else 0
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] == "--explain":
@@ -68,6 +83,15 @@ def main(argv=None) -> int:
         failed = True
     else:
         print("lint_suite: fedtorch_tpu.lint clean vs baseline")
+
+    ftc_rc = run_registry_audit()
+    if ftc_rc != 0:
+        print("lint_suite: registry drift (FTC) — fix the catalog, "
+              "emit site, docs table or drill it names "
+              "(docs/static_analysis.md 'The registry audit')")
+        failed = True
+    else:
+        print("lint_suite: registries in lockstep (FTC clean)")
     return 1 if failed else 0
 
 

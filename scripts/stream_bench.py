@@ -211,7 +211,7 @@ def main():
             server, clients, _ = tr.run_rounds(server, clients, R)
         ref = jax.device_get(server.params)
         del tr
-        # lint: disable=FTL001 — operands already fetched to host
+        # ref/params hold host numpy (device_get above)
         max_diff = max(
             float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
             for a, b in zip(jax.tree.leaves(params),
@@ -243,7 +243,6 @@ def main():
     out["stream_over_device_walltime"] = round(s / d, 3)
     out["overlap_within_10pct"] = bool(s <= 1.10 * d)
     # finals hold HOST numpy (device_get in timed()) — no device sync
-    # lint: disable=FTL001 — operands already fetched to host
     diffs = [float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
              for a, b in zip(jax.tree.leaves(finals["device"]),
                              jax.tree.leaves(finals["stream"]))]

@@ -63,6 +63,16 @@
 #   seqpar           scripts/seqpar_tpu_probe.py  -> SEQPAR_TPU_PROBE.json
 #   baseline         scripts/baseline_suite.py    -> BASELINE_SUITE.json
 #   curves           scripts/northstar_synthetic.py -> NORTHSTAR_CURVE_*.json
+#   audit            python -m fedtorch_tpu.lint --audit
+#                        -> PROGRAM_AUDIT.json (program-level FTP +
+#                         registry FTC audit ON THE TPU BACKEND: every
+#                         legal builder cell abstractly lowered and
+#                         checked for f64/f32-in-bf16 promotion, host
+#                         transfers, donation aliasing, collective
+#                         budget, baked constants, peak-HBM watermark
+#                         — the tier-1 CPU audit re-run against the
+#                         real Mosaic/TPU lowering;
+#                         docs/static_analysis.md "The program audit")
 #
 # This supersedes the per-round stage chains (tpu_capture_full.sh,
 # tpu_capture_r4*.sh, tpu_capture_r5*.sh) — kept for session history;
@@ -83,7 +93,9 @@ TRIES="${TPU_CAPTURE_WAIT_TRIES:-90}"   # ~6 h of patience by default
 # mfu leads: round 6 is the utilization round — the fused-vs-base A/B
 # and the first-ever on-chip traces are the highest-value capture if
 # the relay wedges mid-list
-DEFAULT_STEPS="mfu stream builder-matrix async attack host-chaos \
+# audit rides early: it is seconds of abstract lowering and proves the
+# program invariants on the real backend before the long benches run
+DEFAULT_STEPS="audit mfu stream builder-matrix async attack host-chaos \
 telemetry bench-streaming bench-dispatch bench-unroll bench zoo \
 pallas flash-train vmap baseline"
 STEPS="${*:-$DEFAULT_STEPS}"
@@ -138,6 +150,8 @@ for step in $STEPS; do
         seqpar)         run python scripts/seqpar_tpu_probe.py ;;
         baseline)       run python scripts/baseline_suite.py ;;
         curves)         run python scripts/northstar_synthetic.py ;;
+        audit)          run python -m fedtorch_tpu.lint --audit \
+                            --out PROGRAM_AUDIT.json ;;
         *) echo "[tpu_capture] unknown step: $step"; FAILED=1 ;;
     esac
 done

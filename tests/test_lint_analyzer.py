@@ -383,3 +383,98 @@ def test_baseline_roundtrip(tmp_path):
     shifted = analyze_source("\n\n" + src, "mod.py")
     new2, _ = diff_against_baseline(shifted, base)
     assert new2 == []
+
+
+# -- traced-context discovery: scan/while bodies as LOCAL CLOSURES ----------
+# The PR 11 round_program.py idiom: the loop body is built by a
+# factory / bound to a local name before the tracing call. Direct and
+# partial decoration and direct call-site passing were always modeled;
+# these fixtures pin the binding-resolution extension (ISSUE 13).
+
+def test_scan_body_from_closure_factory_bound_to_local():
+    """`step = _make_body(t)` then `lax.scan(step, ...)` — the factory
+    RESULT is the traced body, reached through the binding map."""
+    src = """\
+    import jax
+    import numpy as np
+
+    def _make_body(c):
+        def body(carry, x):
+            v = np.sqrt(carry)
+            return carry + v * c, x
+        return body
+
+    def driver(init, xs):
+        step = _make_body(2.0)
+        return jax.lax.scan(step, init, xs)
+    """
+    assert hits(src, "FTL002") == [("FTL002", 6)]
+
+
+def test_while_loop_bodies_as_name_assigned_lambdas():
+    src = """\
+    import jax
+    import numpy as np
+
+    def run(x):
+        body = lambda s: (s[0] + np.exp(s[0]), s[1] + 1)
+        cond = lambda s: s[1] < 4
+        return jax.lax.while_loop(cond, body, (x, 0))
+    """
+    assert hits(src, "FTL002") == [("FTL002", 5)]
+
+
+def test_scan_body_rebound_conditionally():
+    """`fn = a_body if flag else b_body` — both candidates trace."""
+    src = """\
+    import jax
+    import numpy as np
+
+    def a_body(c, x):
+        return c + np.log(c), x
+
+    def b_body(c, x):
+        return c * 2, x
+
+    def driver(init, xs, flag):
+        fn = a_body if flag else b_body
+        return jax.lax.scan(fn, init, xs)
+    """
+    assert hits(src, "FTL002") == [("FTL002", 5)]
+
+
+def test_factory_returning_call_result_is_not_traced():
+    """Negative control for the binding resolution: a helper that
+    returns a CALL RESULT (not a function) must not mark itself or
+    its callees traced — `params = run_ascent(...)` is data flow, not
+    closure passing (the over-binding that would cascade false
+    FTL005s through the intra-module call graph)."""
+    src = """\
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def run_ascent(params, xs):
+        if len(xs) > 2:
+            return params
+        return params
+
+    def driver(params, xs):
+        params = run_ascent(params, xs)
+        return jax.lax.scan(lambda c, x: (c, x), params, xs)
+    """
+    assert hits(src) == []
+
+
+def test_traced_lambda_params_are_device_flavored():
+    """A name-assigned lambda marked traced treats its parameters as
+    device values, so in-body hazards (host coercions) are caught."""
+    src = """\
+    import jax
+
+    def run(x):
+        body = lambda s: (s[0] + float(s[0]), s[1] + 1)
+        cond = lambda s: s[1] < 4
+        return jax.lax.while_loop(cond, body, (x, 0))
+    """
+    assert hits(src, "FTL001") == [("FTL001", 4)]

@@ -1,0 +1,195 @@
+"""The registry-drift checker (ISSUE 13, FTC rules).
+
+Two layers:
+
+* **seeded diffs/extractions** — each FTC rule fires on a seeded
+  violation (phantom metrics field, undocumented event, missing seam
+  row, unconsumed CLI flag, unknown illegal cell) through the same
+  pure extraction/diff functions the audit composes;
+* **zero drift at head** — ``audit_registries(repo_root)`` must come
+  back EMPTY on the checked-in tree: emit sites ⊆ catalogs, catalogs
+  ⊆ emit sites (or reserved), every seam drilled and documented,
+  every CLI flag consumed, every illegal cell snapshot-tested. This
+  is the tier-1 gate every later PR inherits.
+
+Also pins the docs tables in docs/static_analysis.md against
+``rules.markdown_table`` so the rendered rule catalog cannot drift
+from the registry.
+"""
+import os
+
+from fedtorch_tpu.lint.registry_audit import (
+    audit_registries, axis_tuples, consumed_args, diff_builder_cells,
+    diff_config_cli, diff_event_names, diff_metric_fields,
+    documented_event_names, documented_row_fields, documented_seams,
+    emitted_event_names_from_source, emitted_row_fields_from_source,
+    illegal_cells, parser_dests,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- seeded violations -------------------------------------------------------
+
+class TestSeededFTC001:
+    def test_phantom_emitted_field(self):
+        fs = diff_metric_fields(
+            emitted={"round", "my_new_gauge"},
+            cataloged={"round"}, documented={"round"})
+        assert any(f.rule == "FTC001" and "my_new_gauge" in f.message
+                   and "not cataloged" in f.message for f in fs)
+
+    def test_cataloged_never_emitted(self):
+        fs = diff_metric_fields(
+            emitted={"round"}, cataloged={"round", "ghost"},
+            documented={"round", "ghost"})
+        assert any("ghost" in f.message and "no emit site" in f.message
+                   for f in fs)
+        # reserved names are exempt
+        fs = diff_metric_fields(
+            emitted={"round"}, cataloged={"round", "ghost"},
+            documented={"round", "ghost"}, reserved=("ghost",))
+        assert fs == []
+
+    def test_undocumented_field(self):
+        fs = diff_metric_fields(
+            emitted={"round", "new_gauge"},
+            cataloged={"round", "new_gauge"}, documented={"round"})
+        assert any("new_gauge" in f.message and "missing from the"
+                   in f.message for f in fs)
+
+    def test_row_field_extraction(self):
+        src = (
+            "def loop():\n"
+            "    row = {'round': r, 'loss': l}\n"
+            "    row['extra_s'] = 1.0\n"
+            "    row.update(sup_retries=2.0)\n"
+            "    row.update({'host_faults': 3.0})\n"
+            "class C:\n"
+            "    def stats(self):\n"
+            "        out = {'ckpt_writes': 1.0}\n"
+            "        out['ckpt_queue_depth'] = 0.0\n"
+            "        return out\n")
+        assert emitted_row_fields_from_source(src) == {
+            "round", "loss", "extra_s", "sup_retries", "host_faults",
+            "ckpt_writes", "ckpt_queue_depth"}
+
+
+class TestSeededFTC002:
+    def test_event_extraction_and_diff(self):
+        src = ("tel.event('run.start', round=0)\n"
+               "telemetry.event('chaos.host_fault', seam=s)\n")
+        emitted = emitted_event_names_from_source(src)
+        assert emitted == {"run.start", "chaos.host_fault"}
+        fs = diff_event_names(emitted, {"run.start"})
+        assert any(f.rule == "FTC002" and "chaos.host_fault" in f.message
+                   for f in fs)
+        fs = diff_event_names({"run.start"},
+                              {"run.start", "ghost.event"})
+        assert any("ghost.event" in f.message and "no emit site"
+                   in f.message for f in fs)
+
+    def test_doc_event_section_extraction(self):
+        doc = ("Events (`events.jsonl`): `run.start`, `run.end`, and\n"
+               "`host.recovered` (see `robustness.md` and `schema.py`).\n"
+               "\n## Span taxonomy\n`stream.gather` spans\n")
+        names = documented_event_names(doc)
+        assert names == {"run.start", "run.end", "host.recovered"}
+
+
+class TestSeededFTC003:
+    def test_seam_table_extraction(self):
+        md = ("| seam | site |\n|---|---|\n"
+              "| `stream.gather` | producer |\n"
+              "| `ckpt.write` | writer |\n"
+              "| *(producer death)* | any |\n")
+        assert documented_seams(md) == {"stream.gather", "ckpt.write"}
+
+
+class TestSeededFTC004:
+    def test_unconsumed_and_phantom_dests(self):
+        src = (
+            "def build_parser():\n"
+            "    p.add_argument('--lr', type=float)\n"
+            "    p.add_argument('--dead_flag', type=int)\n"
+            "    p.add_argument('-j', '--workers', dest='num_workers')\n"
+            "def args_to_config(args):\n"
+            "    return (args.lr, args.num_workers, args.phantom)\n")
+        dests, used = parser_dests(src), consumed_args(src)
+        assert dests.keys() == {"lr", "dead_flag", "num_workers"}
+        fs = diff_config_cli(dests, used, non_config=())
+        msgs = "\n".join(f.message for f in fs)
+        assert "dead_flag" in msgs and "phantom" in msgs
+        assert all(f.rule == "FTC004" for f in fs)
+
+    def test_clean_surface_passes(self):
+        src = (
+            "def build_parser():\n"
+            "    p.add_argument('--lr', type=float)\n"
+            "def args_to_config(args):\n"
+            "    return args.lr\n")
+        assert diff_config_cli(parser_dests(src), consumed_args(src),
+                               non_config=()) == []
+
+
+class TestSeededFTC005:
+    AXES_SRC = ("SOURCES = ('resident', 'feed')\n"
+                "DISPATCHES = ('round', 'scan', 'commit')\n"
+                "EXECUTIONS = ('vmap', 'fused')\n")
+
+    def test_unknown_axis_value_in_illegal_cell(self):
+        test_src = ("ILLEGAL = {('resident', 'warp', 'fused')}\n"
+                    "iter_cells\n")
+        fs = diff_builder_cells(axis_tuples(self.AXES_SRC),
+                                illegal_cells(test_src), test_src)
+        assert any(f.rule == "FTC005" and "warp" in f.message
+                   for f in fs)
+
+    def test_missing_refusal_snapshot(self):
+        test_src = ("ILLEGAL = {('resident', 'commit', 'fused')}\n"
+                    "iter_cells\n")  # no '(resident x commit x fused)'
+        fs = diff_builder_cells(axis_tuples(self.AXES_SRC),
+                                illegal_cells(test_src), test_src)
+        assert any("refusal-message snapshot" in f.message for f in fs)
+
+    def test_snapshot_plus_enumeration_passes(self):
+        test_src = ("ILLEGAL = {('resident', 'commit', 'fused')}\n"
+                    "iter_cells\n"
+                    "# pins '(resident x commit x fused)' exactly\n")
+        assert diff_builder_cells(axis_tuples(self.AXES_SRC),
+                                  illegal_cells(test_src),
+                                  test_src) == []
+
+
+# -- zero drift at head ------------------------------------------------------
+
+def test_zero_registry_drift_at_head():
+    """The checked-in tree must be drift-free: the checker lands green
+    with an EMPTY baseline (ISSUE 13 acceptance), so any future
+    uncataloged gauge, undocumented event/seam, dead CLI flag or
+    unsnapshotted illegal cell fails tier-1 here."""
+    findings = audit_registries(REPO)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_docs_tables_match_rules_registry():
+    """docs/static_analysis.md embeds the FTP/FTC tables rendered from
+    rules.py — byte-for-byte, so the docs cannot drift from the
+    registry (the tables are generated, not hand-maintained)."""
+    from fedtorch_tpu.lint.rules import (
+        PROGRAM_RULES, REGISTRY_RULES, markdown_table,
+    )
+    doc = open(os.path.join(REPO, "docs/static_analysis.md")).read()
+    assert markdown_table(PROGRAM_RULES) in doc
+    assert markdown_table(REGISTRY_RULES) in doc
+
+
+def test_head_doc_field_extraction_is_sane():
+    """Guard the extraction itself: the docs metric catalog must yield
+    a plausibly-sized field set (an empty set would make the
+    documented-direction checks vacuously green)."""
+    doc = open(os.path.join(REPO, "docs/observability.md")).read()
+    fields = documented_row_fields(doc)
+    assert {"round", "loss", "model_flops_utilization",
+            "ckpt_total_write_s"} <= fields
+    assert len(fields) > 30

@@ -265,6 +265,60 @@ def test_algorithm_precondition_cells_raise_named(source, dispatch,
     assert "round-program cell" in str(err.value)
 
 
+# -- refusal-message snapshots (the gate matrix is user-facing API) -------
+# One test per structurally illegal cell pinning the EXACT ValueError
+# text, so refusal wording cannot silently regress. The registry-drift
+# checker (fedtorch_tpu.lint.registry_audit, FTC005) requires each
+# illegal cell's name to appear here next to the ILLEGAL set.
+
+def _validate(source, dispatch, execution, sync_mode):
+    cfg = make_cfg(source, execution=execution, sync_mode=sync_mode)
+    alg = make_algorithm(cfg)
+    model = define_model(cfg, batch_size=cfg.data.batch_size)
+    from fedtorch_tpu.parallel.round_program import validate_cell
+    validate_cell(source, dispatch, execution, cfg=cfg, algorithm=alg,
+                  model=model, mesh_devices=1, k_online=2,
+                  gather_mode="auto", has_val=False)
+
+
+_COMMIT_FUSED_REASON = (
+    "client_fusion='fused' packs clients into one grouped conv "
+    "against ONE shared server snapshot; buffered commits train each "
+    "client against its own dispatch-time version — use the vmap "
+    "execution or --sync_mode sync")
+
+
+def test_refusal_snapshot_resident_commit_fused():
+    with pytest.raises(ValueError) as err:
+        _validate("resident", "commit", "fused", "async")
+    assert str(err.value) == (
+        "round-program cell (resident x commit x fused) is "
+        "unsupported here: " + _COMMIT_FUSED_REASON)
+
+
+def test_refusal_snapshot_feed_commit_fused():
+    with pytest.raises(ValueError) as err:
+        _validate("feed", "commit", "fused", "async")
+    assert str(err.value) == (
+        "round-program cell (feed x commit x fused) is "
+        "unsupported here: " + _COMMIT_FUSED_REASON)
+
+
+def test_refusal_snapshot_scan_under_async():
+    """The deferred scan gate's exact text (run_rounds on the async
+    plane) — structurally impossible like the fused commits, but
+    refused at call time rather than construction."""
+    with pytest.raises(ValueError) as err:
+        _validate("resident", "scan", "vmap", "async")
+    assert str(err.value) == (
+        "round-program cell (resident x scan x vmap) is unsupported "
+        "here: run_rounds scans ONE traced round program over R "
+        "rounds' inputs, but async commits are host-scheduled events "
+        "(each commit's jobs come from the event scheduler), so no "
+        "R-commit program exists to scan — call run_round once per "
+        "commit, or use --sync_mode sync for the scan dispatch")
+
+
 def test_matrix_has_no_silently_absent_cells():
     """Every combination of the module's axis tuples is either in this
     file's ILLEGAL set (and refused by the validator) or reaches a
