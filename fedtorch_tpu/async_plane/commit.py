@@ -183,6 +183,12 @@ class AsyncFederatedTrainer(FederatedTrainer):
                              self.programs.build("commit")),
             donate_argnums=(0, 1)) \
             if self.data_plane == "stream" else None
+        # last scheduler's staleness histogram, preserved across
+        # invalidate_stream teardowns so run-end/drain telemetry can
+        # still emit it (the CLI's finally reads it AFTER the stream
+        # teardown; a rebuilt scheduler's fast-forward replays every
+        # commit, so a later live histogram supersedes the stash)
+        self._hist_stash: Optional[dict] = None
 
     # -- state -----------------------------------------------------------
     def init_state(self, rng: jax.Array):
@@ -313,7 +319,11 @@ class AsyncFederatedTrainer(FederatedTrainer):
         """Also drop the event scheduler: any rewrite of host-visible
         training state (supervisor rollback/reseed, resume, drain)
         desyncs the replay; the next commit re-syncs from the live
-        (rng, round) device state."""
+        (rng, round) device state. The staleness histogram is stashed
+        first — it is pure telemetry over ALREADY-committed updates,
+        so it survives the teardown unchanged."""
+        if self._sched is not None and self._sched.staleness_hist:
+            self._hist_stash = dict(self._sched.staleness_hist)
         super().invalidate_stream()
         self._sched = None
 
@@ -348,8 +358,11 @@ class AsyncFederatedTrainer(FederatedTrainer):
 
     def staleness_histogram(self):
         """{commits-stale: count} over every committed update so far
-        (post ring-clamp) — emitted as one ``events.jsonl`` record at
-        drain/run-end rather than per-row (it is a dict, not a scalar
-        gauge)."""
-        return dict(self._sched.staleness_hist) \
-            if self._sched is not None else None
+        (post ring-clamp) — emitted as ``events.jsonl`` snapshot
+        records (drain path, debug cadence, run end) rather than
+        per-row (it is a dict, not a scalar gauge). Falls back to the
+        pre-``invalidate_stream`` stash so the run-end emission — which
+        runs after the stream teardown — still sees it."""
+        if self._sched is not None and self._sched.staleness_hist:
+            return dict(self._sched.staleness_hist)
+        return dict(self._hist_stash) if self._hist_stash else None

@@ -404,6 +404,34 @@ def build_parser() -> argparse.ArgumentParser:
                         "programs only. Ignored (with a logged note) "
                         "under --sync_mode async, whose commit plane "
                         "refuses the scan dispatch")
+    p.add_argument("--cohort_stats", type=str2bool, default=False,
+                   help="federation-plane cohort statistics "
+                        "(docs/observability.md 'Federation plane'): "
+                        "the aggregation seam additionally emits "
+                        "per-client accept/selection masks, the "
+                        "robust rule's suspicion scores, per-job "
+                        "staleness, update-norm quantiles and the "
+                        "cosine-dispersion heterogeneity gauge — all "
+                        "riding the round loop's one batched fetch "
+                        "into per-round gauges and the per-client "
+                        "client_ledger.json. Off (default) the round/"
+                        "commit program is byte-identical to the "
+                        "stats-free engine; on, it traces once and "
+                        "trajectories stay bitwise-identical")
+    p.add_argument("--ledger_sketch_budget", type=int, default=65536,
+                   help="population threshold/budget of the per-"
+                        "client ledger: dense numpy counters at "
+                        "num_clients <= budget, count-min "
+                        "participation sketch + suspicion top-K "
+                        "above it — ledger memory stays "
+                        "O(min(C, budget)) at C >= 1e6")
+    p.add_argument("--anomaly_zscore", type=float, default=6.0,
+                   help="EWMA z-score threshold of the observe-only "
+                        "anomaly detector over the metrics rows "
+                        "(loss, cohort dispersion, guard-reject "
+                        "rate, staleness) — emits anomaly.detected "
+                        "events, never drives control flow; 0 "
+                        "disables")
     return p
 
 
@@ -521,7 +549,10 @@ def args_to_config(args) -> ExperimentConfig:
             client_fusion=args.client_fusion),
         telemetry=TelemetryConfig(
             level=args.telemetry,
-            cost_capture_scan_rounds=args.cost_capture_scan_rounds),
+            cost_capture_scan_rounds=args.cost_capture_scan_rounds,
+            cohort_stats=args.cohort_stats,
+            ledger_sketch_budget=args.ledger_sketch_budget,
+            anomaly_zscore=args.anomaly_zscore),
         fault=FaultConfig(
             client_drop_rate=args.fault_client_drop_rate,
             straggler_rate=args.fault_straggler_rate,
@@ -778,6 +809,36 @@ def run_experiment(cfg: ExperimentConfig,
                           "sync_mode": cfg.federated.sync_mode,
                           "data_plane": cfg.data.data_plane},
                 log=logger.log)
+        # federation-plane observability (docs/observability.md
+        # "Federation plane"): the per-client ledger accumulates the
+        # cohort vectors the batched fetch now carries (cohort_stats
+        # on, writer process only), and the observe-only anomaly
+        # detector watches the finished metrics rows. Both host-only.
+        ledger = None
+        anomaly = None
+        if tel.enabled and tel.is_writer and cfg.telemetry.cohort_stats:
+            from fedtorch_tpu.telemetry.ledger import ClientLedger
+            ledger = ClientLedger(
+                ckpt_dir, num_clients=cfg.federated.num_clients,
+                sketch_budget=cfg.telemetry.ledger_sketch_budget,
+                seed=cfg.train.manual_seed,
+                run_meta={"algorithm": cfg.effective_algorithm,
+                          "robust_agg": cfg.fault.robust_agg,
+                          "sync_mode": cfg.federated.sync_mode},
+                log=logger.log)
+            if ledger.load_existing():
+                # elastic restarts ADOPT the run dir's ledger (the
+                # program_costs.json convention) — counters resume
+                # instead of overwriting the history with zeros
+                logger.log("client ledger: adopted existing "
+                           f"client_ledger.json ({ledger.rounds} "
+                           "rounds)")
+        if tel.enabled and cfg.telemetry.anomaly_zscore > 0.0:
+            from fedtorch_tpu.telemetry.anomaly import (
+                EwmaAnomalyDetector,
+            )
+            anomaly = EwmaAnomalyDetector(
+                zscore=cfg.telemetry.anomaly_zscore)
         # still inside the guard: this fetch can raise too (device
         # fault, poisoned resume state) and must not leak the active
         # telemetry / a 'starting' intent for a dead run
@@ -812,15 +873,30 @@ def run_experiment(cfg: ExperimentConfig,
             # ONE batched device->host fetch for everything this loop
             # logs (round_host_scalars) — per-scalar float() here would
             # serialize a transfer per metric per round (lint FTL001).
-            # A supervised healthy round already fetched the same dict
-            # for its health check: reuse it, don't transfer twice.
+            # The ledger's per-client cohort vectors ride the SAME
+            # device_get when cohort_stats is on. A supervised healthy
+            # round already fetched the scalar dict for its health
+            # check: reuse it (only the [k] cohort vectors transfer).
+            led_dev = trainer.cohort_fetch_dev(metrics) \
+                if ledger is not None else None
+            led = None
             fetch_t0 = time.perf_counter()
             if supervisor is not None and \
                     supervisor.last_scalars is not None:
                 sc = supervisor.last_scalars
+                if led_dev is not None:
+                    led = jax.device_get(led_dev)
             else:
                 with tel.span("scalar_fetch", round=r):
-                    sc = trainer.round_host_scalars(clients, metrics)
+                    if led_dev is None:
+                        sc = trainer.round_host_scalars(clients,
+                                                        metrics)
+                    else:
+                        sc_dev, led = jax.device_get(
+                            (trainer.round_scalars_dev(clients,
+                                                       metrics),
+                             led_dev))
+                        sc = {k: float(v) for k, v in sc_dev.items()}
             fetch_s = time.perf_counter() - fetch_t0
             timer.add_comm(num_bytes=sc["comm_bytes"])
             # the scalar fetch blocked on the round's results: the
@@ -981,6 +1057,23 @@ def run_experiment(cfg: ExperimentConfig,
                 row["best_top1"] = best_prec1
             if checkpoint_s is not None:
                 row["checkpoint_s"] = checkpoint_s
+            if "cohort_dispersion" in sc:
+                # the heterogeneity gauge (cohort_stats on) — already
+                # part of the batched scalar fetch
+                row["cohort_dispersion"] = sc["cohort_dispersion"]
+            if led is not None:
+                # cohort norm quantiles + the per-client ledger fold
+                # (host numpy from the same fetch; O(k) update)
+                nq = led["norm_q"]
+                row.update({
+                    "cohort_norm_min": float(nq[0]),
+                    "cohort_norm_q25": float(nq[1]),
+                    "cohort_norm_med": float(nq[2]),
+                    "cohort_norm_q75": float(nq[3]),
+                    "cohort_norm_max": float(nq[4]),
+                })
+                ledger.update(r, led)
+                row.update(ledger.stats())
             row.update(trainer.telemetry_gauges())
             if cost_capture is not None:
                 # measured MFU + HBM watermark pair — empty until the
@@ -1000,6 +1093,22 @@ def run_experiment(cfg: ExperimentConfig,
             if injector is not None:
                 row.update(injector.stats())
             tel.round_row(row)
+            if anomaly is not None:
+                # observe-only EWMA z-score pass over the finished row
+                # (telemetry/anomaly.py): events + report fodder, no
+                # control flow
+                for a in anomaly.observe(row):
+                    tel.event("anomaly.detected", round=r, **a)
+            if cfg.telemetry.level == "debug" and (r + 1) % 25 == 0:
+                # debug cadence snapshot of the async staleness
+                # histogram: a hard-killed run (watchdog os._exit)
+                # keeps at most 25 commits of histogram, not all of it
+                hist = trainer.staleness_histogram()
+                if hist:
+                    tel.event("async.staleness_hist", round=r,
+                              snapshot="debug",
+                              hist={str(k): v
+                                    for k, v in sorted(hist.items())})
             # health: r+1 rounds complete — same convention as
             # checkpoint.json's "round", so monitors can compare the
             # live counter against the last durable one. Intent
@@ -1033,6 +1142,17 @@ def run_experiment(cfg: ExperimentConfig,
                            f"draining after round {r}")
                 tel.event("preempt.drain", round=r,
                           reason=preempt.reason or "peer host")
+                hist = trainer.staleness_histogram()
+                if hist:
+                    # drain-path snapshot (async plane): the final
+                    # emission reads the histogram after the stream
+                    # teardown; snapshotting here makes the preempted
+                    # run's histogram durable even if the drain's own
+                    # checkpoint write later raises
+                    tel.event("async.staleness_hist", round=r,
+                              snapshot="drain",
+                              hist={str(k): v
+                                    for k, v in sorted(hist.items())})
                 tel.health_update("drain", round_idx=r + 1)
                 # the resume point the restart depends on must be
                 # DURABLE before exit 75 — a failure here must RAISE,
@@ -1075,6 +1195,12 @@ def run_experiment(cfg: ExperimentConfig,
         # outlive the loop in library callers
         watchdog.stop()
         preempt.restore()
+        # read the staleness histogram BEFORE the stream teardown: the
+        # async trainer's invalidate_stream drops the event scheduler
+        # that owns it, which silently lost the run-end
+        # async.staleness_hist event on every CLI run (the trainer
+        # also stashes it across invalidation now — belt and braces)
+        final_hist = trainer.staleness_histogram()
         # streaming data plane: stop the feed producer and drop any
         # in-flight prefetch — a preemption drain (exit 75) must not
         # leave a worker thread blocked on the feed queue, and a
@@ -1107,15 +1233,18 @@ def run_experiment(cfg: ExperimentConfig,
                     timer.stop("checkpoint")
         finally:
             # final telemetry: the staleness histogram (async plane),
-            # the run-end event, the exit intent, and the trace export
-            # — best-effort bookkeeping that must never mask the
-            # loop's outcome (the emitters and Telemetry.close never
-            # raise)
-            hist = trainer.staleness_histogram()
-            if hist:
-                tel.event("async.staleness_hist",
+            # the ledger flush, the run-end event, the exit intent,
+            # and the trace export — best-effort bookkeeping that must
+            # never mask the loop's outcome (the emitters, the ledger
+            # flush and Telemetry.close never raise)
+            if final_hist:
+                tel.event("async.staleness_hist", snapshot="final",
                           hist={str(k): v
-                                for k, v in sorted(hist.items())})
+                                for k, v in sorted(final_hist.items())})
+            if ledger is not None:
+                ledger.flush()
+            if anomaly is not None:
+                tel.event("anomaly.summary", fields=anomaly.summary())
             tel.event("run.end",
                       preempted=bool(results.get("preempted")),
                       raised=loop_raised or flush_raised)

@@ -516,6 +516,31 @@ class TelemetryConfig:
     # programs only (the default; the scan twin is a second XLA
     # compile at capture time).
     cost_capture_scan_rounds: int = 0
+    # Federation-plane cohort statistics (docs/observability.md
+    # "Federation plane"). UNLIKE every other telemetry knob this one
+    # changes the traced round/commit program: it adds per-client
+    # outputs at the _round_core aggregation seam — online ids, accept
+    # /selection masks, per-client suspicion from the robust rule,
+    # per-job staleness, update-norm quantiles and the cosine-
+    # dispersion heterogeneity gauge — all riding the loop's ONE
+    # batched fetch and feeding the per-client ledger
+    # (telemetry/ledger.py). Off (default) the program is byte-
+    # identical to the pre-cohort engine (the new RoundMetrics fields
+    # are None — zero extra outputs); on, it traces once and the
+    # trajectory stays bitwise-identical (tests/test_cohort_stats.py).
+    cohort_stats: bool = False
+    # population threshold/budget of the per-client ledger: at
+    # num_clients <= budget the ledger keeps dense per-client numpy
+    # counters; above it, count-min participation sketches plus a
+    # bounded suspicion top-K — memory stays O(min(C, budget)) at
+    # C >= 10^6 (measured in TELEMETRY_AB.json's ledger_memory row).
+    ledger_sketch_budget: int = 65536
+    # EWMA z-score threshold of the host-side anomaly detector
+    # (telemetry/anomaly.py) over the metrics rows (loss, cohort
+    # dispersion, guard-reject rate, staleness). Observe-only: it
+    # emits `anomaly.detected` events and feeds the report's
+    # Federation section, never control flow. 0 disables.
+    anomaly_zscore: float = 6.0
 
 
 @dataclass(frozen=True)
@@ -791,6 +816,15 @@ class ExperimentConfig:
                 "telemetry.cost_capture_scan_rounds must be >= 0 "
                 "(0 = per-round programs only), got "
                 f"{self.telemetry.cost_capture_scan_rounds}")
+        if self.telemetry.ledger_sketch_budget < 64:
+            raise ValueError(
+                "telemetry.ledger_sketch_budget must be >= 64 (the "
+                "sketch needs a few rows of width to say anything), "
+                f"got {self.telemetry.ledger_sketch_budget}")
+        if self.telemetry.anomaly_zscore < 0.0:
+            raise ValueError(
+                "telemetry.anomaly_zscore must be >= 0 (0 = detector "
+                f"off), got {self.telemetry.anomaly_zscore}")
 
         return dataclasses.replace(
             self, data=data, federated=fed, train=train, optim=optim)

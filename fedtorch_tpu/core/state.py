@@ -63,6 +63,22 @@ class RoundMetrics(NamedTuple):
     byzantine_clients: jnp.ndarray = 0.0  # scalar — crafted uploads
     robust_selected: jnp.ndarray = 0.0    # scalar — updates aggregated
     robust_trimmed: jnp.ndarray = 0.0     # scalar — excluded/clipped
+    # federation-plane cohort statistics (telemetry.cohort_stats —
+    # docs/observability.md "Federation plane"). None (the default)
+    # contributes ZERO pytree leaves, so with the gauge off the round
+    # program's outputs — and its HLO — are byte-identical to the
+    # pre-cohort engine. When on, all are per-ONLINE-client [k]
+    # (async: per buffered job [m]) except the [5] norm quantiles and
+    # the scalar dispersion; they ride the loop's one batched fetch
+    # into the per-client ledger (telemetry/ledger.py).
+    cohort_idx: Any = None         # [k] int32 online client ids
+    cohort_online: Any = None      # [k] {0,1} survived the round
+    cohort_accept: Any = None      # [k] {0,1} chaos+guard candidate
+    cohort_selected: Any = None    # [k] {0,1} the rule aggregated it
+    cohort_suspicion: Any = None   # [k] robust-rule suspicion score
+    cohort_staleness: Any = None   # [k] commit staleness (0 on sync)
+    cohort_norm_q: Any = None      # [5] update-norm quantiles
+    cohort_dispersion: Any = None  # scalar 1 - mean cos(u_i, mean)
 
 
 def tree_where(pred, on_true, on_false):

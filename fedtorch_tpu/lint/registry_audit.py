@@ -151,6 +151,7 @@ _EMIT_SITE_FILES = (
     "fedtorch_tpu/robustness/host_recovery.py",
     "fedtorch_tpu/robustness/host_chaos.py",
     "fedtorch_tpu/telemetry/costs.py",
+    "fedtorch_tpu/telemetry/ledger.py",
 )
 
 

@@ -93,7 +93,9 @@ from fedtorch_tpu.parallel.mesh import (
 )
 from fedtorch_tpu import telemetry
 from fedtorch_tpu.robustness import host_recovery
-from fedtorch_tpu.robustness.aggregators import robust_aggregate
+from fedtorch_tpu.robustness.aggregators import (
+    cohort_statistics, robust_aggregate,
+)
 from fedtorch_tpu.robustness.chaos import (
     BYZ_COHORT_FOLD, BYZ_NOISE_FOLD, apply_byzantine,
     byzantine_cohort_mask, draw_chaos_plan, no_chaos_plan, poison_tree,
@@ -180,6 +182,14 @@ class FederatedTrainer:
         # ring wraps OUTSIDE this, so the two compose).
         self.robust_rule = cfg.fault.robust_agg
         self.robust_momentum = self.robust_rule == "norm_bound"
+        # federation-plane cohort statistics (telemetry.cohort_stats,
+        # docs/observability.md "Federation plane"): static config —
+        # off (default) the round program is byte-identical to the
+        # pre-cohort engine (the extra RoundMetrics fields stay None,
+        # contributing zero outputs); on, the aggregation seam emits
+        # per-client masks/suspicion + the heterogeneity gauges and
+        # they ride the loop's one batched fetch into the ledger
+        self.cohort_stats = bool(cfg.telemetry.cohort_stats)
 
         # data source + gather mode: the refusals (explicit 'shard' on
         # a packed-row program, feed-source algorithm preconditions,
@@ -713,13 +723,24 @@ class FederatedTrainer:
         # the server step and client_post see the same sum
         robust_selected = robust_trimmed = jnp.zeros(())
         new_robust_m = robust_m
+        # per-client cohort evidence at the seam (None = stats off —
+        # the default traces the exact pre-cohort program)
+        cohort = None
         if self.robust_rule != "mean":
             accept_f = accept if accept is not None else jnp.ones((k,))
             payload_sum, new_robust_m, rreport = robust_aggregate(
                 self.robust_rule, payloads, weights, accept_f, flt,
-                momentum=robust_m)
+                momentum=robust_m, per_client=self.cohort_stats)
             robust_selected = rreport.selected
             robust_trimmed = rreport.trimmed
+            if self.cohort_stats:
+                # the rule's own evidence (krum scores, trim fractions,
+                # clip ratios) is the suspicion; the dispersion/norm
+                # gauges come from the shared cohort statistics
+                cs = cohort_statistics(payloads, weights, accept_f)
+                cohort = {"accept": accept_f, "sel": rreport.sel_mask,
+                          "susp": rreport.suspicion,
+                          "norm_q": cs.norm_q, "disp": cs.dispersion}
         else:
             payload_sum = jax.tree.map(lambda p: jnp.sum(p, axis=0),
                                        payloads)
@@ -731,6 +752,14 @@ class FederatedTrainer:
                 # it (guards.py).
                 payload_sum = renormalize_accepted(payload_sum, weights,
                                                    accept)
+            if self.cohort_stats:
+                accept_f = accept if accept is not None \
+                    else jnp.ones((k,))
+                cs = cohort_statistics(payloads, weights, accept_f)
+                cand = accept_f * (weights > 0.0).astype(accept_f.dtype)
+                cohort = {"accept": accept_f, "sel": cand,
+                          "susp": cs.suspicion,
+                          "norm_q": cs.norm_q, "disp": cs.dispersion}
         payload_sum = alg.aggregate_transform(payload_sum)
 
         new_params, new_opt, new_saux = alg.server_update(
@@ -800,6 +829,22 @@ class FederatedTrainer:
             # through checkpoints and the async snapshot ring unchanged
             new_server = new_server._replace(aux={
                 "alg": new_server.aux, "norm_bound_m": new_robust_m})
+        # federation-plane cohort fields (telemetry.cohort_stats):
+        # per-online-client evidence + heterogeneity gauges. The
+        # staleness vector is the sync plane's zeros here; the commit
+        # program overwrites it with each job's real commit staleness
+        # (parallel/round_program.py:_commit_core).
+        cohort_fields = {}
+        if cohort is not None:
+            cohort_fields = dict(
+                cohort_idx=idx.astype(jnp.int32),
+                cohort_online=online * jnp.ones((k,)),
+                cohort_accept=cohort["accept"],
+                cohort_selected=cohort["sel"],
+                cohort_suspicion=cohort["susp"],
+                cohort_staleness=jnp.zeros((k,)),
+                cohort_norm_q=cohort["norm_q"],
+                cohort_dispersion=cohort["disp"])
         metrics = RoundMetrics(
             train_loss=loss_full, train_acc=acc_full,
             online_mask=mask_full, comm_bytes=comm_bytes,
@@ -810,7 +855,8 @@ class FederatedTrainer:
             clipped_updates=jnp.asarray(clipped, jnp.float32),
             byzantine_clients=jnp.asarray(byz_count, jnp.float32),
             robust_selected=jnp.asarray(robust_selected, jnp.float32),
-            robust_trimmed=jnp.asarray(robust_trimmed, jnp.float32))
+            robust_trimmed=jnp.asarray(robust_trimmed, jnp.float32),
+            **cohort_fields)
         return new_server, new_clients, metrics
 
     # -- fused client round (cfg.mesh.client_fusion='fused') --------------
@@ -1005,9 +1051,34 @@ class FederatedTrainer:
             "robust_selected": metrics.robust_selected,
             "robust_trimmed": metrics.robust_trimmed,
         }
+        if metrics.cohort_dispersion is not None:
+            # the heterogeneity gauge (telemetry.cohort_stats) rides
+            # the same fetch; absent — not 0 — when stats are off
+            out["cohort_dispersion"] = metrics.cohort_dispersion
         if self._stop_signal is not None:
             out["stop"] = self.stop_flag_dev(bool(self._stop_signal()))
         return out
+
+    def cohort_fetch_dev(self, metrics) -> Optional[dict]:
+        """Device-side per-client cohort vectors for the ledger
+        (telemetry/ledger.py): online ids, survive/accept/selection
+        masks, the robust rule's suspicion, per-job staleness, and the
+        [5] update-norm quantiles. None when ``cohort_stats`` is off.
+        The CLI loop batches this dict into the SAME ``device_get`` as
+        :meth:`round_scalars_dev`, so the per-round device-sync count
+        stays at the one fetch (docs/observability.md "Federation
+        plane")."""
+        if metrics.cohort_idx is None:
+            return None
+        return {
+            "idx": metrics.cohort_idx,
+            "online": metrics.cohort_online,
+            "accept": metrics.cohort_accept,
+            "selected": metrics.cohort_selected,
+            "suspicion": metrics.cohort_suspicion,
+            "staleness": metrics.cohort_staleness,
+            "norm_q": metrics.cohort_norm_q,
+        }
 
     def round_host_scalars(self, clients, metrics) -> dict:
         """Everything the host round loop logs, fetched in ONE batched

@@ -451,6 +451,11 @@ class RoundProgramBuilder:
         metrics = metrics._replace(
             straggler_clients=jnp.sum(jobs.straggler),
             staleness_mean=jnp.mean(stale))
+        if metrics.cohort_staleness is not None:
+            # cohort stats on: the per-JOB commit staleness replaces
+            # _round_core's sync-plane zeros, so the ledger records the
+            # staleness each buffered update actually carried
+            metrics = metrics._replace(cohort_staleness=stale)
         return new_server, new_clients, metrics
 
 

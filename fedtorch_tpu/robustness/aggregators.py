@@ -47,7 +47,8 @@ carry the staleness damping.
 """
 from __future__ import annotations
 
-from typing import NamedTuple, Optional, Tuple
+import math
+from typing import Any, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -64,9 +65,35 @@ _BIG = 1e30
 
 
 class RobustReport(NamedTuple):
-    """What the robust rule did this round (all jit-traced scalars)."""
+    """What the robust rule did this round (all jit-traced scalars).
+
+    The per-client halves (``sel_mask``/``suspicion``) are the evidence
+    the rules always computed and used to discard: krum's pairwise-
+    distance scores, trimmed_mean's per-client trim fractions,
+    norm_bound's distance-to-momentum clip ratios. They are populated
+    only under ``per_client=True`` (the engine's ``cohort_stats``
+    gauge — docs/observability.md "Federation plane"); the default
+    ``None`` adds no outputs, keeping the stats-off program
+    byte-identical. Suspicion semantics per rule:
+
+    * ``mean``/``median`` — l2 distance of the unit update to the
+      (weighted mean | coordinate median) estimate, normalized by the
+      candidates' median distance (honest cluster ~1, outliers >> 1);
+    * ``krum``/``multikrum`` — the Krum score normalized by the
+      candidates' median score;
+    * ``trimmed_mean`` — the fraction of the client's coordinates the
+      trim window excluded (in [0, 1]; a colluding client trims
+      everywhere, an honest one ~2*beta);
+    * ``norm_bound`` — distance-to-momentum over the clip radius tau
+      (> 1 means the update was radially clipped).
+
+    Non-candidates (crashed / guard-rejected / zero-weight) score 0 —
+    their evidence for the round is the rejection itself, which the
+    ledger counts separately."""
     selected: jnp.ndarray  # updates the rule actually aggregated
     trimmed: jnp.ndarray   # updates excluded/clipped beyond the guards
+    sel_mask: Any = None   # [k] {0,1} per-client aggregation verdict
+    suspicion: Any = None  # [k] per-client suspicion score
 
 
 def _is_float(x) -> bool:
@@ -156,9 +183,84 @@ def _trimmed_window(a: jnp.ndarray, frac: float):
     return lo, hi, jnp.maximum(hi - lo, 1.0)
 
 
+# -- federation-plane cohort statistics (docs/observability.md) ----------
+
+def _normalized_score(score: jnp.ndarray, candb: jnp.ndarray
+                      ) -> jnp.ndarray:
+    """Score over the candidates' median score — scale-free, so one
+    suspicion vocabulary covers every rule (honest cluster ~1);
+    non-candidates (and a degenerate all-equal round) score 0."""
+    med = jnp.nanmedian(jnp.where(candb, score, jnp.nan))
+    s = score / jnp.maximum(med, 1e-30)
+    return jnp.where(jnp.isnan(s) | ~candb, 0.0, s)
+
+
+class CohortStats(NamedTuple):
+    """The heterogeneity gauges of one round's accepted cohort — the
+    quantities the ATTACK_AB heterogeneity caveat (robustness.md §2b)
+    needed a live measurement of. All jit-traced."""
+    norm_q: jnp.ndarray      # [5] unit-update-norm quantiles
+                             # (min, q25, median, q75, max)
+    dispersion: jnp.ndarray  # scalar 1 - mean cos(u_i, weighted mean)
+    suspicion: jnp.ndarray   # [k] normalized distance-to-mean
+
+
+def cohort_statistics(payloads, weights: jnp.ndarray,
+                      accept: jnp.ndarray) -> CohortStats:
+    """In-jit cohort statistics over the stacked ``[k]`` payloads at
+    the aggregation seam (``telemetry.cohort_stats``): update-norm
+    quantiles, the cosine-dispersion heterogeneity gauge (an IID
+    cohort reads ~0; the LEAF generator's intrinsic heterogeneity
+    reads ~0.65 at cos~0.35), and a distance-to-weighted-mean
+    suspicion — the ``mean`` rule's evidence, and the fallback
+    vocabulary when no robust rule is armed. Statistics run on the
+    per-unit-weight updates (the aggregators' scale convention) over
+    the accepted candidates only.
+
+    Everything reduces LEAF-WISE (||u_i||², ⟨u_i, ū⟩, ||ū||² — with
+    ū the leaf-wise weighted candidate mean, and ‖u_i − ū‖² by the
+    inner-product expansion): no concatenated [k, D] flattening is
+    ever materialized, so the statistics cost a few fused passes over
+    the payload tree instead of tripling the round's memory traffic
+    (measured: the flattened form added ~50% bytes-accessed to an
+    MLP round program)."""
+    cand = accept * (weights > 0.0).astype(accept.dtype)
+    candb = cand.astype(bool)
+    unit = _unit_updates(payloads, weights)
+    w = weights * cand
+    W = jnp.maximum(jnp.sum(w), 1e-30)
+    k = weights.shape[0]
+    sq = jnp.zeros((k,))   # ||u_i||^2
+    dot = jnp.zeros((k,))  # <u_i, mean>
+    msq = jnp.zeros(())    # ||mean||^2
+    for u in jax.tree.leaves(unit):
+        if not _is_float(u):
+            continue
+        uf = u.astype(jnp.float32)
+        axes = tuple(range(1, uf.ndim))
+        mean_l = jnp.sum(uf * _bcast(w, uf), axis=0) / W
+        sq = sq + jnp.sum(uf * uf, axis=axes)
+        dot = dot + jnp.sum(uf * mean_l[None], axis=axes)
+        msq = msq + jnp.sum(mean_l * mean_l)
+    norms = jnp.sqrt(sq)
+    norm_q = jnp.nanquantile(
+        jnp.where(candb, norms, jnp.nan),
+        jnp.asarray([0.0, 0.25, 0.5, 0.75, 1.0]))
+    norm_q = jnp.where(jnp.isnan(norm_q), 0.0, norm_q)
+    mnorm = jnp.sqrt(msq)
+    cos = dot / jnp.maximum(norms * mnorm, 1e-30)
+    dispersion = 1.0 - jnp.sum(cos * cand) / jnp.maximum(
+        jnp.sum(cand), 1.0)
+    # ||u_i - mean||^2 = ||u_i||^2 - 2<u_i, mean> + ||mean||^2
+    # (clamped: the expansion can dip below 0 at float precision)
+    dist = jnp.sqrt(jnp.maximum(sq - 2.0 * dot + msq, 0.0))
+    return CohortStats(norm_q=norm_q, dispersion=dispersion,
+                       suspicion=_normalized_score(dist, candb))
+
+
 def robust_aggregate(rule: str, payloads, weights: jnp.ndarray,
                      accept: jnp.ndarray, fault: FaultConfig,
-                     momentum=None):
+                     momentum=None, per_client: bool = False):
     """Aggregate the stacked ``[k, ...]`` payloads under ``rule``.
 
     ``accept`` is the engine's final {0,1} mask (chaos survivors x
@@ -168,6 +270,12 @@ def robust_aggregate(rule: str, payloads, weights: jnp.ndarray,
     is scaled to the full round weight ``sum(weights)`` — the drop-in
     replacement for the mean path's renormalized sum. ``new_momentum``
     is None except under ``norm_bound``.
+
+    ``per_client=True`` (static — the engine's ``cohort_stats`` gate)
+    additionally fills the report's per-client ``sel_mask`` and
+    ``suspicion`` instead of discarding the evidence the rule computed
+    (see :class:`RobustReport`); the aggregate itself is bitwise
+    unaffected.
     """
     if rule not in ROBUST_AGGREGATORS:
         raise ValueError(
@@ -183,20 +291,28 @@ def robust_aggregate(rule: str, payloads, weights: jnp.ndarray,
     if rule == "mean":
         payload_sum = _masked_sum(payloads, cand)
         payload_sum = renormalize_accepted(payload_sum, weights, cand)
-        return payload_sum, None, RobustReport(selected=a, trimmed=zero)
+        rep = RobustReport(selected=a, trimmed=zero)
+        if per_client:
+            cs = cohort_statistics(payloads, weights, accept)
+            rep = rep._replace(sel_mask=cand, suspicion=cs.suspicion)
+        return payload_sum, None, rep
 
     if rule in ("krum", "multikrum"):
         unit = _unit_updates(payloads, weights)
-        sel, _ = krum_selection(unit, cand, fault.robust_trim_frac,
-                                multi=rule == "multikrum")
+        sel, scores = krum_selection(unit, cand, fault.robust_trim_frac,
+                                     multi=rule == "multikrum")
         payload_sum = _masked_sum(payloads, sel)
         # the issue with selection rules IS the weight path: the mask
         # rides the SAME renormalization as crashes/guard rejections,
         # so the selected clients inherit the full round weight
         payload_sum = renormalize_accepted(payload_sum, weights, sel)
         n_sel = jnp.sum(sel)
-        return payload_sum, None, RobustReport(
+        rep = RobustReport(
             selected=n_sel, trimmed=jnp.maximum(a - n_sel, 0.0))
+        if per_client:
+            rep = rep._replace(
+                sel_mask=sel, suspicion=_normalized_score(scores, candb))
+        return payload_sum, None, rep
 
     unit = _unit_updates(payloads, weights)
 
@@ -210,7 +326,22 @@ def robust_aggregate(rule: str, payloads, weights: jnp.ndarray,
             return (m.astype(jnp.float32) * W).astype(u.dtype)
 
         payload_sum = jax.tree.map(agg, unit)
-        return payload_sum, None, RobustReport(selected=a, trimmed=zero)
+        rep = RobustReport(selected=a, trimmed=zero)
+        if per_client:
+            # distance to the coordinate-median estimate (XLA CSEs the
+            # second median against agg()'s)
+            sq = zero
+            for u in jax.tree.leaves(unit):
+                if not _is_float(u):
+                    continue
+                m = med(u)
+                diff = u.astype(jnp.float32) - m[None].astype(jnp.float32)
+                sq = sq + jnp.sum(jnp.square(diff),
+                                  axis=tuple(range(1, diff.ndim)))
+            rep = rep._replace(
+                sel_mask=cand,
+                suspicion=_normalized_score(jnp.sqrt(sq), candb))
+        return payload_sum, None, rep
 
     if rule == "trimmed_mean":
         lo, hi, width = _trimmed_window(a, fault.robust_trim_frac)
@@ -230,8 +361,30 @@ def robust_aggregate(rule: str, payloads, weights: jnp.ndarray,
 
         payload_sum = jax.tree.map(agg, unit)
         trimmed = jnp.maximum(a - width, 0.0)
-        return payload_sum, None, RobustReport(
-            selected=width, trimmed=trimmed)
+        rep = RobustReport(selected=width, trimmed=trimmed)
+        if per_client:
+            # per-client trimmed-coordinate fraction: rank every value
+            # inside its coordinate's sorted candidate block (double
+            # argsort = rank of each original row) and count how often
+            # the client fell outside the kept [lo, hi) window
+            out_coords = jnp.zeros((k,))
+            n_coords = 0
+            for u in jax.tree.leaves(unit):
+                if not _is_float(u):
+                    continue
+                vals = jnp.where(_bcast(candb, u), u.astype(jnp.float32),
+                                 jnp.inf)
+                ranks = jnp.argsort(jnp.argsort(vals, axis=0), axis=0) \
+                    .astype(jnp.float32)
+                out = (ranks < lo) | (ranks >= hi)
+                out_coords = out_coords + jnp.sum(
+                    out.astype(jnp.float32),
+                    axis=tuple(range(1, u.ndim)))
+                n_coords += int(math.prod(u.shape[1:]))
+            frac = out_coords / jnp.maximum(float(n_coords), 1.0)
+            rep = rep._replace(
+                sel_mask=cand, suspicion=jnp.where(candb, frac, 0.0))
+        return payload_sum, None, rep
 
     # norm_bound: radial clip toward the server momentum, then the
     # standard renormalized weighted mean over the candidates
@@ -270,5 +423,10 @@ def robust_aggregate(rule: str, payloads, weights: jnp.ndarray,
         lambda p, m: (p.astype(jnp.float32) * inv_w).astype(m.dtype)
         if _is_float(p) else m, payload_sum, momentum)
     n_clipped = jnp.sum(cand * (scale < 1.0).astype(cand.dtype))
-    return payload_sum, new_momentum, RobustReport(
-        selected=a, trimmed=n_clipped)
+    rep = RobustReport(selected=a, trimmed=n_clipped)
+    if per_client:
+        # distance-to-momentum over the clip radius: > 1 == clipped
+        susp = dist / jnp.maximum(tau, 1e-30)
+        rep = rep._replace(
+            sel_mask=cand, suspicion=jnp.where(candb, susp, 0.0))
+    return payload_sum, new_momentum, rep

@@ -29,9 +29,16 @@ Library-code usage (no Telemetry object in scope)::
 """
 from __future__ import annotations
 
+from fedtorch_tpu.telemetry.anomaly import (  # noqa: F401
+    ANOMALY_FIELDS, EwmaAnomalyDetector,
+)
 from fedtorch_tpu.telemetry.costs import (  # noqa: F401
     PROGRAM_COSTS_SCHEMA, ProgramCostCapture, program_costs_path,
     read_program_costs, resolve_peak_tflops, validate_program_costs,
+)
+from fedtorch_tpu.telemetry.ledger import (  # noqa: F401
+    LEDGER_SCHEMA, ClientLedger, ledger_path, read_client_ledger,
+    suspicion_ranking, validate_client_ledger,
 )
 from fedtorch_tpu.telemetry.health import (  # noqa: F401
     HealthFile, health_path, read_health,

@@ -1,0 +1,122 @@
+"""Host-side anomaly detection over the metrics rows
+(docs/observability.md "Federation plane").
+
+A stdlib EWMA z-score detector the CLI loop feeds each completed
+metrics row: per watched field it tracks an exponentially-weighted mean
+and variance and, once past warmup, flags values more than
+``zscore`` standard deviations out — a diverging loss, a dispersion
+spike (an attack cohort or a partition shift), a guard-rejection burst,
+a staleness runaway. Strictly **observe-only**: anomalies become
+``anomaly.detected`` events (and the report tool's Federation section)
+and drive NO control flow — the supervisor's rollback/retry machinery
+(robustness/supervisor.py) stays the only actor, this is the operator's
+smoke alarm.
+
+Emission discipline: one event per field per EXCURSION (the detector
+re-arms when the field returns inside the band), capped per field so a
+permanently-shifted metric cannot flood ``events.jsonl`` on a
+month-long run. The EWMA keeps absorbing every value — including
+anomalous ones — so a genuine level shift becomes the new normal
+instead of alerting forever.
+
+Stdlib-only (not even numpy): O(fields) floats of state, O(1) per row.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Tuple
+
+# metrics-row fields watched by default. ``reject_rate`` is derived
+# (rejected / max(n_online, 1)) — the raw count scales with k and
+# would alias cohort-size changes into anomalies.
+ANOMALY_FIELDS = ("loss", "cohort_dispersion", "reject_rate",
+                  "staleness")
+
+
+class EwmaAnomalyDetector:
+    """Per-field EWMA mean/variance + z-score excursion detection."""
+
+    def __init__(self, zscore: float = 6.0, fields=ANOMALY_FIELDS,
+                 alpha: float = 0.1, warmup: int = 10,
+                 max_events_per_field: int = 20):
+        if zscore <= 0.0:
+            raise ValueError(f"zscore must be > 0, got {zscore}")
+        self.zscore = float(zscore)
+        self.fields = tuple(fields)
+        self.alpha = float(alpha)
+        self.warmup = int(warmup)
+        self.max_events_per_field = int(max_events_per_field)
+        # field -> (n, mean, var, in_excursion, emitted)
+        self._state: Dict[str, Tuple[int, float, float, bool, int]] = {
+            f: (0, 0.0, 0.0, False, 0) for f in self.fields}
+
+    @staticmethod
+    def derive(row: Dict) -> Dict[str, float]:
+        """The derived fields observed alongside the raw row."""
+        out = {}
+        if "rejected" in row and "n_online" in row:
+            out["reject_rate"] = float(row["rejected"]) \
+                / max(float(row["n_online"]), 1.0)
+        return out
+
+    def observe(self, row: Dict) -> List[Dict]:
+        """Feed one metrics row; returns the (possibly empty) list of
+        anomaly records — ``{"field", "value", "zscore", "ewma_mean",
+        "ewma_std"}`` — for the caller to emit as ``anomaly.detected``
+        events. Never raises on missing/odd fields: telemetry must not
+        outcrash the loop it watches."""
+        values = dict(row)
+        values.update(self.derive(row))
+        out: List[Dict] = []
+        for field in self.fields:
+            v = values.get(field)
+            if v is None or isinstance(v, bool) \
+                    or not isinstance(v, (int, float)):
+                continue
+            x = float(v)
+            n, mean, var, in_exc, emitted = self._state[field]
+            std = math.sqrt(max(var, 0.0))
+            anomalous = False
+            z: Optional[float] = None
+            if not math.isfinite(x):
+                # a NaN/Inf metric is an anomaly by definition (and
+                # must not poison the EWMA below)
+                anomalous = n >= self.warmup
+            elif n >= self.warmup:
+                dev = abs(x - mean)
+                if std > 0.0:
+                    z = dev / std
+                    anomalous = z > self.zscore
+                else:
+                    # a zero-variance history (e.g. a reject rate that
+                    # was 0.0 every round) makes ANY departure
+                    # infinitely many sigmas out — z stays None
+                    anomalous = dev > max(1e-9 * abs(mean), 1e-12)
+            if anomalous and not in_exc \
+                    and emitted < self.max_events_per_field:
+                out.append({
+                    "field": field, "value": x if math.isfinite(x)
+                    else repr(x),
+                    "zscore": round(z, 2) if z is not None else None,
+                    "ewma_mean": round(mean, 6),
+                    "ewma_std": round(std, 6)})
+                emitted += 1
+            if math.isfinite(x):
+                # standard EW mean/variance update (West 1979 form);
+                # anomalous values are absorbed too — a level shift
+                # becomes the new normal instead of alerting forever
+                diff = x - mean
+                incr = self.alpha * diff
+                mean += incr
+                var = (1.0 - self.alpha) * (var + diff * incr)
+                n += 1
+            self._state[field] = (n, mean, var, anomalous, emitted)
+        return out
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        """Per-field detector state for end-of-run reporting."""
+        return {
+            f: {"observations": n, "ewma_mean": round(mean, 6),
+                "ewma_std": round(math.sqrt(max(var, 0.0)), 6),
+                "events": emitted}
+            for f, (n, mean, var, _exc, emitted) in self._state.items()}

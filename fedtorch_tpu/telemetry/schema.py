@@ -104,6 +104,23 @@ METRICS_OPTIONAL = {
                               "memory watermark (memory_analysis)",
     "hbm_live_bytes": "live jax.Array bytes at row time "
                       "(live_buffer_summary — metadata walk, no sync)",
+    # federation-plane cohort statistics (telemetry.cohort_stats;
+    # robustness/aggregators.py:cohort_statistics — docs/
+    # observability.md "Federation plane")
+    "cohort_dispersion": "1 - mean cosine of the accepted unit "
+                         "updates vs their weighted mean (the "
+                         "heterogeneity gauge)",
+    "cohort_norm_min": "min accepted unit-update l2 norm",
+    "cohort_norm_q25": "25th-percentile accepted unit-update norm",
+    "cohort_norm_med": "median accepted unit-update norm",
+    "cohort_norm_q75": "75th-percentile accepted unit-update norm",
+    "cohort_norm_max": "max accepted unit-update norm",
+    # per-client ledger (telemetry/ledger.py)
+    "ledger_tracked": "clients with exact per-client ledger records "
+                      "(dense: the population; sketch: the "
+                      "suspicion top-K)",
+    "ledger_bytes": "ledger host-memory footprint — bounded "
+                    "O(min(C, ledger_sketch_budget))",
 }
 
 def all_metric_fields() -> frozenset:

@@ -167,9 +167,57 @@ def summarize(run_dir: str) -> Dict:
                              if k in ev}
             break
     s["robustness"] = rob
+    # the Federation section (docs/observability.md "Federation
+    # plane"): cohort heterogeneity gauges, the per-client ledger's
+    # suspicion ranking, and the anomaly detector's verdicts — the
+    # federation-plane answer to "who participated and who looked
+    # wrong", machine-readable for `report --json` consumers.
+    fed: Dict = {}
+    disp = [r["cohort_dispersion"] for r in rows
+            if "cohort_dispersion" in r]
+    if disp:
+        fed["cohort"] = {"rounds": len(disp),
+                         "dispersion_last": disp[-1],
+                         "dispersion_mean": sum(disp) / len(disp)}
+        meds = [r["cohort_norm_med"] for r in rows
+                if "cohort_norm_med" in r]
+        if meds:
+            fed["cohort"]["norm_med_last"] = meds[-1]
+    anomalies: Dict = {}
+    for ev in run["events"]:
+        if ev.get("event") == "anomaly.detected":
+            f = ev.get("field", "?")
+            anomalies[f] = anomalies.get(f, 0) + 1
+    if anomalies:
+        fed["anomalies"] = anomalies
+    for ev in reversed(run["events"]):
+        if ev.get("event") == "async.staleness_hist":
+            fed["staleness_hist"] = ev.get("hist", {})
+            break
+    try:
+        from fedtorch_tpu.telemetry.ledger import (
+            read_client_ledger, suspicion_ranking,
+        )
+        doc = read_client_ledger(run_dir)
+        fed["ledger"] = {
+            "mode": doc["mode"], "rounds": doc["rounds"],
+            "num_clients": doc["num_clients"],
+            "tracked": doc["num_clients"] if doc["mode"] == "dense"
+            else len(doc.get("top", {})),
+            "top_suspicion": suspicion_ranking(doc, top=5),
+        }
+    except FileNotFoundError:
+        pass
+    except ValueError as e:
+        # the file exists but does not validate: a broken ledger is a
+        # finding, not a non-ledger
+        fed["ledger_error"] = str(e)
+    if fed:
+        s["federation"] = fed
     last = rows[-1]
     for key in sorted(last):
-        if key.startswith(("stream_", "async_", "ckpt_", "sup_")):
+        if key.startswith(("stream_", "async_", "ckpt_", "sup_",
+                           "cohort_", "ledger_")):
             s["last_gauges"][key] = last[key]
     return s
 
@@ -232,6 +280,39 @@ def render(run_dir: str) -> str:
                        ("mode", "rate", "scale", "robust_agg")}))
         for name, n in (rob.get("events") or {}).items():
             lines.append(f"  event {name:<22} x{n}")
+    fed = s.get("federation") or {}
+    if fed:
+        lines.append("federation plane (cohort stats / ledger / "
+                     "anomalies):")
+        if "cohort" in fed:
+            c = fed["cohort"]
+            line = (f"  dispersion: last {c['dispersion_last']:.4f}  "
+                    f"mean {c['dispersion_mean']:.4f}  "
+                    f"({c['rounds']} rounds)")
+            if "norm_med_last" in c:
+                line += f"  median update norm {c['norm_med_last']:.4g}"
+            lines.append(line)
+        if "ledger" in fed:
+            led = fed["ledger"]
+            lines.append(
+                f"  ledger: {led['mode']} mode, "
+                f"{led['tracked']}/{led['num_clients']} clients "
+                f"tracked over {led['rounds']} rounds")
+            if led.get("top_suspicion"):
+                tops = "  ".join(f"c{cid}:{sus:.2f}"
+                                 for cid, sus in led["top_suspicion"])
+                lines.append(f"  top suspicion: {tops}")
+        if "ledger_error" in fed:
+            lines.append(f"  ledger: unreadable ({fed['ledger_error']})")
+        if "anomalies" in fed:
+            kv = " ".join(f"{k}={v}" for k, v in
+                          sorted(fed["anomalies"].items()))
+            lines.append(f"  anomalies: {kv}")
+        if "staleness_hist" in fed:
+            kv = " ".join(f"{k}:{v}" for k, v in
+                          sorted(fed["staleness_hist"].items(),
+                                 key=lambda p: int(p[0])))
+            lines.append(f"  staleness histogram: {kv}")
     if s["last_gauges"]:
         lines.append("subsystem gauges (last round):")
         for k, v in s["last_gauges"].items():
@@ -309,7 +390,25 @@ def main(argv=None) -> int:
                    help="additionally render the device-side section: "
                         "program_costs.json + profiler-trace "
                         "attribution (works on bare capture dirs too)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="print summarize()'s machine-readable dict "
+                        "(incl. the Federation section) as JSON — the "
+                        "CI-consumable form; mutually additive with "
+                        "the text report being suppressed")
     args = p.parse_args(argv)
+    if args.as_json:
+        import json as _json
+        try:
+            s = summarize(args.run_dir)
+        except FileNotFoundError as e:
+            print(f"report: {e}", file=sys.stderr)
+            return 2
+        # phases are tuples (not JSON-stable): make them objects
+        s["phases"] = [
+            {"phase": n, "total_s": t, "share": share, "rounds": c}
+            for n, t, share, c in (s.get("phases") or [])]
+        print(_json.dumps(s, indent=2, sort_keys=True, default=str))
+        return 0
     rendered = False
     try:
         print(render(args.run_dir))

@@ -578,6 +578,145 @@ def run_attack_matrix(rounds: int = 20, smoke: bool = False,
     return report
 
 
+def run_ledger_attack(rounds: int = 20, smoke: bool = False,
+                      seed: int = 6, byzantine_rate: float = 0.25,
+                      byzantine_scale: float = 3.0, aggregators=None,
+                      min_precision: float = 0.66,
+                      out_path: str = None) -> dict:
+    """The ledger-separation drill (ISSUE 14): one REAL CLI run
+    (``run_experiment`` — telemetry, the batched cohort fetch, the
+    per-client ledger) per robust rule with the PR 9 persistent
+    byzantine cohort armed and ``--cohort_stats`` on. Acceptance: the
+    persisted ``client_ledger.json``'s cumulative-suspicion ranking
+    must SEPARATE the true adversarial cohort from honest clients —
+    precision/recall of the top-``n`` ranking (``n`` = cohort size)
+    against the cohort mask recomputed from the seed (the cohort is a
+    pure function of ``server.rng``, robustness/chaos.py). Writes
+    ``COHORT_AB.json``.
+
+    Guards stay ON in every cell (the PR 9 threat model: these attacks
+    pass the benign-fault screen, so suspicion — not rejection — is
+    the only record naming the adversaries)."""
+    import tempfile
+
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import numpy as np
+
+    from fedtorch_tpu.cli import run_experiment
+    from fedtorch_tpu.config import (
+        CheckpointConfig, DataConfig, ExperimentConfig, FaultConfig,
+        FederatedConfig, ModelConfig, OptimConfig, TelemetryConfig,
+        TrainConfig,
+    )
+    from fedtorch_tpu.robustness.chaos import (
+        BYZ_COHORT_FOLD, byzantine_cohort_mask,
+    )
+    from fedtorch_tpu.telemetry.ledger import (
+        read_client_ledger, suspicion_ranking,
+    )
+    from fedtorch_tpu.telemetry.schema import iter_jsonl
+
+    aggregators = tuple(aggregators) if aggregators else (
+        ("median",) if smoke else ("median", "krum", "trimmed_mean"))
+    C = 8 if smoke else 12
+    rounds = max(rounds, 6 if smoke else 8)
+    trim = min(byzantine_rate + 0.1, 0.45)
+
+    # the true cohort: byzantine_cohort_mask folds BYZ_COHORT_FOLD off
+    # server.rng, and init_state sets server.rng = split(key(seed))[0]
+    # — replay the same two steps (pure function of the seed)
+    run_key = jax.random.split(jax.random.key(seed))[0]
+    cohort = np.asarray(jax.device_get(byzantine_cohort_mask(
+        jax.random.fold_in(run_key, BYZ_COHORT_FOLD), C,
+        byzantine_rate)))
+    true = set(np.nonzero(cohort)[0].tolist())
+    n = len(true)
+    assert n > 0, "byzantine_rate * C rounded to an empty cohort"
+
+    report = {
+        "clients": C, "rounds": rounds, "seed": seed,
+        "byzantine_rate": byzantine_rate,
+        "byzantine_scale": byzantine_scale, "robust_trim_frac": trim,
+        "byzantine_mode": "sign_flip", "true_cohort": sorted(true),
+        "min_precision": min_precision, "cells": {},
+    }
+    t0 = time.time()
+    for agg in aggregators:
+        run_dir = tempfile.mkdtemp(prefix=f"ledger_attack_{agg}_")
+        cfg = ExperimentConfig(
+            data=DataConfig(dataset="synthetic", synthetic_dim=10,
+                            batch_size=8),
+            federated=FederatedConfig(
+                federated=True, num_clients=C, num_comms=rounds,
+                online_client_rate=1.0, algorithm="fedavg",
+                sync_type="local_step"),
+            model=ModelConfig(arch="logistic_regression"),
+            optim=OptimConfig(lr=0.1, weight_decay=0.0),
+            train=TrainConfig(local_step=2, manual_seed=seed,
+                              eval_freq=rounds),
+            checkpoint=CheckpointConfig(run_dir=run_dir, debug=False),
+            telemetry=TelemetryConfig(cohort_stats=True),
+            fault=FaultConfig(
+                byzantine_rate=byzantine_rate,
+                byzantine_mode="sign_flip",
+                byzantine_scale=byzantine_scale, guard_updates=True,
+                robust_agg=agg, robust_trim_frac=trim),
+        ).finalize()
+        run_experiment(cfg)
+
+        rows = [r for r in iter_jsonl(
+            os.path.join(run_dir, "metrics.jsonl")) if "schema" not in r]
+        injected = sum(r.get("byzantine", 0.0) for r in rows)
+        assert injected > 0, \
+            f"{agg}: the attack schedule injected nothing"
+        doc = read_client_ledger(run_dir)
+        assert doc["rounds"] == rounds, \
+            f"{agg}: ledger recorded {doc['rounds']}/{rounds} rounds"
+        ranking = suspicion_ranking(doc)
+        top = {cid for cid, _ in ranking[:n]}
+        hits = len(top & true)
+        precision = hits / n
+        recall = hits / n  # |top| == |true| == n, so the two coincide
+        by_client = dict(ranking)
+        byz_mean = float(np.mean([by_client.get(c, 0.0)
+                                  for c in sorted(true)]))
+        honest = [c for c in range(C) if c not in true]
+        honest_mean = float(np.mean([by_client.get(c, 0.0)
+                                     for c in honest]))
+        cell = {
+            "precision": round(precision, 4),
+            "recall": round(recall, 4),
+            "byzantine_injected": int(injected),
+            "top_ranking": [[int(c), round(float(s), 4)]
+                            for c, s in ranking[:n]],
+            "byz_suspicion_mean": round(byz_mean, 4),
+            "honest_suspicion_mean": round(honest_mean, 4),
+            "separation": round(byz_mean / max(honest_mean, 1e-9), 3),
+        }
+        report["cells"][agg] = cell
+        log(f"ledger attack x {agg}: precision {precision:.2f} "
+            f"recall {recall:.2f} separation x{cell['separation']} "
+            f"({int(injected)} byz injected)")
+        assert precision >= min_precision, (
+            f"{agg}: suspicion ranking precision {precision:.2f} < "
+            f"{min_precision} — the ledger does not separate the "
+            "byzantine cohort")
+    best = max(report["cells"].values(), key=lambda c: c["precision"])
+    report["acceptance"] = {
+        "best_precision": best["precision"],
+        "all_cells_pass": True,
+    }
+    report["wall_seconds"] = round(time.time() - t0, 1)
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+        log(f"ledger-attack report written to {out_path}")
+    return report
+
+
 # the host-fault matrix's seam axis IS the config tuple — a new seam
 # landing without a drill cell fails here, not in production
 from fedtorch_tpu.config import HOST_FAULT_SEAMS  # noqa: E402
@@ -978,7 +1117,24 @@ def main():
                          "program; writes --builder-out")
     ap.add_argument("--builder-out", default="BUILDER_MATRIX.json",
                     help="output path for the builder-matrix report")
+    ap.add_argument("--ledger-attack", action="store_true",
+                    help="run the ledger-separation drill instead: a "
+                         "real CLI run per robust rule with the PR 9 "
+                         "byzantine cohort + --cohort_stats on, "
+                         "asserting the persisted client_ledger.json "
+                         "suspicion ranking separates the adversarial "
+                         "cohort from honest clients (precision/"
+                         "recall); writes --ledger-out "
+                         "(docs/observability.md 'Federation plane')")
+    ap.add_argument("--ledger-out", default="COHORT_AB.json",
+                    help="output path for the ledger-attack report")
     args = ap.parse_args()
+    if args.ledger_attack:
+        report = run_ledger_attack(rounds=args.rounds,
+                                   smoke=args.smoke, seed=args.seed,
+                                   out_path=args.ledger_out)
+        print(json.dumps(report), flush=True)
+        return
     if args.builder_matrix:
         report = run_builder_matrix(rounds=args.rounds,
                                     smoke=args.smoke, seed=args.seed,

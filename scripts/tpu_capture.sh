@@ -40,6 +40,14 @@
 #                         retry/degraded counters+events, and a dead
 #                         stream producer must rebuild instead of
 #                         aborting — docs/robustness.md "Host plane")
+#   cohort           scripts/chaos_suite.py --ledger-attack
+#                        -> COHORT_AB.json (ledger-separation drill:
+#                         a real CLI run per robust rule with the
+#                         byzantine cohort + --cohort_stats armed; the
+#                         persisted client_ledger.json suspicion
+#                         ranking must separate the adversarial cohort
+#                         — precision/recall per rule;
+#                         docs/observability.md "Federation plane")
 #   telemetry        scripts/telemetry_bench.py   -> TELEMETRY_AB.json
 #                        (off/default/debug overhead A/B on the
 #                         north-star config, <=1% acceptance) +
@@ -96,7 +104,7 @@ TRIES="${TPU_CAPTURE_WAIT_TRIES:-90}"   # ~6 h of patience by default
 # audit rides early: it is seconds of abstract lowering and proves the
 # program invariants on the real backend before the long benches run
 DEFAULT_STEPS="audit mfu stream builder-matrix async attack host-chaos \
-telemetry bench-streaming bench-dispatch bench-unroll bench zoo \
+cohort telemetry bench-streaming bench-dispatch bench-unroll bench zoo \
 pallas flash-train vmap baseline"
 STEPS="${*:-$DEFAULT_STEPS}"
 
@@ -125,6 +133,9 @@ for step in $STEPS; do
         host-chaos)     run python scripts/chaos_suite.py \
                             --host-fault-matrix --rounds 12 \
                             --host-out HOST_CHAOS_AB.json ;;
+        cohort)         run python scripts/chaos_suite.py \
+                            --ledger-attack --rounds 25 --seed 6 \
+                            --ledger-out COHORT_AB.json ;;
         telemetry)      run python scripts/telemetry_bench.py \
                             --capture-run artifacts/telemetry_northstar ;;
         conv-ab)        run env BENCH_CONV_IMPL=matmul python bench.py

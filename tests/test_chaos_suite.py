@@ -68,6 +68,22 @@ def test_attack_matrix_acceptance():
 
 
 @pytest.mark.slow
+def test_ledger_attack_acceptance():
+    """ISSUE 14 acceptance: a real CLI run with the PR 9 byzantine
+    cohort armed and --cohort_stats on must leave a client_ledger.json
+    whose cumulative-suspicion ranking separates the adversarial
+    cohort from honest clients (top-n precision/recall over the
+    cohort recomputed from the seed)."""
+    from chaos_suite import run_ledger_attack
+    report = run_ledger_attack(rounds=8, smoke=True)
+    assert report["acceptance"]["all_cells_pass"]
+    for agg, cell in report["cells"].items():
+        assert cell["byzantine_injected"] > 0, agg
+        assert cell["precision"] >= report["min_precision"], agg
+        assert cell["separation"] > 1.0, agg
+
+
+@pytest.mark.slow
 def test_host_fault_matrix_acceptance():
     """ISSUE 10 acceptance: for every host seam at the default
     injection rate the run completes with a bitwise-identical

@@ -1,0 +1,560 @@
+"""Federation-plane observability (ISSUE 14, docs/observability.md
+"Federation plane"): the cohort-stats parity suite, the per-client
+ledger, and the anomaly detector.
+
+The hard bars made executable here:
+
+* with ``--cohort_stats`` OFF the round program's outputs are exactly
+  the pre-cohort engine's (the new RoundMetrics fields contribute zero
+  pytree leaves, pinned by leaf count) and the lowered HLO does not
+  depend on any of the new host-only telemetry knobs;
+* with it ON, every representative builder cell (device/stream x
+  sync/async, plus the scan dispatch) traces exactly once and the
+  per-round trajectory is bitwise-identical to the stats-off run;
+* the robust aggregators' per-client reports are consistent with their
+  scalar counters and rank an adversarial outlier on top;
+* the ledger is deterministic under seed, resume-adopted, and
+  O(min(C, budget)) in memory at C=10^6;
+* the anomaly detector is observe-only, warmup-gated, and re-arming.
+"""
+import json
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedtorch_tpu.algorithms import make_algorithm
+from fedtorch_tpu.config import (
+    CheckpointConfig, DataConfig, ExperimentConfig, FaultConfig,
+    FederatedConfig, ModelConfig, OptimConfig, TelemetryConfig,
+    TrainConfig,
+)
+from fedtorch_tpu.core.state import RoundMetrics
+from fedtorch_tpu.data import build_federated_data
+from fedtorch_tpu.models import define_model
+from fedtorch_tpu.robustness.aggregators import (
+    RobustReport, cohort_statistics, robust_aggregate,
+)
+from fedtorch_tpu.telemetry.anomaly import EwmaAnomalyDetector
+from fedtorch_tpu.telemetry.ledger import (
+    LEDGER_SCHEMA, ClientLedger, read_client_ledger,
+    suspicion_ranking, validate_client_ledger,
+)
+from fedtorch_tpu.utils.tracing import RecompilationSentinel
+
+# the pre-cohort RoundMetrics output arity: 3 [C] vectors + 9 scalars.
+# The cohort fields default to None (zero leaves), which is WHY the
+# stats-off program lowers to byte-identical HLO — this pin is the
+# structural half of that acceptance bar.
+PRE_COHORT_METRIC_LEAVES = 12
+
+RULES = ("mean", "median", "trimmed_mean", "krum", "multikrum",
+         "norm_bound")
+
+
+def _payloads(k=6, d=8, outlier=None, seed=0):
+    """Stacked [k, d] single-leaf payloads with unit weights; client
+    ``outlier`` (if any) uploads a sign-flipped 5x update."""
+    rng = np.random.RandomState(seed)
+    base = rng.randn(d).astype(np.float32)
+    u = base[None, :] + 0.05 * rng.randn(k, d).astype(np.float32)
+    if outlier is not None:
+        u[outlier] = -5.0 * base
+    return {"delta": jnp.asarray(u)}, jnp.ones((k,)), jnp.ones((k,))
+
+
+def _fault(rule, trim=0.25):
+    return FaultConfig(robust_agg=rule, robust_trim_frac=trim,
+                       robust_norm_tau=1.5)
+
+
+class TestAggregatorPerClient:
+    @pytest.mark.parametrize("rule", RULES)
+    def test_aggregate_bitwise_unchanged_by_per_client(self, rule):
+        """per_client=True only ADDS report fields — the aggregate
+        (and momentum) must be bitwise what per_client=False returns."""
+        payloads, weights, accept = _payloads(outlier=2)
+        mom = {"delta": jnp.zeros((8,))} if rule == "norm_bound" \
+            else None
+        outs = []
+        for pc in (False, True):
+            s, m, rep = robust_aggregate(rule, payloads, weights,
+                                         accept, _fault(rule),
+                                         momentum=mom, per_client=pc)
+            outs.append((jax.device_get(s["delta"]),
+                         None if m is None
+                         else jax.device_get(m["delta"]), rep))
+        np.testing.assert_array_equal(outs[0][0], outs[1][0])
+        if outs[0][1] is not None:
+            np.testing.assert_array_equal(outs[0][1], outs[1][1])
+        assert outs[0][2].sel_mask is None
+        assert outs[0][2].suspicion is None
+        assert outs[1][2].sel_mask is not None
+        assert outs[1][2].suspicion is not None
+
+    @pytest.mark.parametrize("rule", RULES)
+    def test_outlier_ranks_most_suspect(self, rule):
+        """Satellite 2: the evidence the rules used to discard — krum
+        scores, trim fractions, clip ratios — must rank the planted
+        sign-flipped client on top for EVERY rule."""
+        payloads, weights, accept = _payloads(outlier=3)
+        mom = {"delta": jnp.zeros((8,))} if rule == "norm_bound" \
+            else None
+        _, _, rep = robust_aggregate(rule, payloads, weights, accept,
+                                     _fault(rule), momentum=mom,
+                                     per_client=True)
+        susp = np.asarray(jax.device_get(rep.suspicion))
+        assert int(np.argmax(susp)) == 3, susp
+
+    def test_krum_sel_mask_matches_scalar_counter(self):
+        """The per-client selection mask and the ``robust_selected``
+        scalar gauge must agree — the disagreement satellite 2 closes."""
+        payloads, weights, accept = _payloads(outlier=1)
+        _, _, rep = robust_aggregate("multikrum", payloads, weights,
+                                     accept, _fault("multikrum"),
+                                     per_client=True)
+        sel_mask, sel = jax.device_get((rep.sel_mask, rep.selected))
+        assert float(np.sum(sel_mask)) == float(sel)
+        # the planted adversary is never selected
+        assert sel_mask[1] == 0.0
+
+    def test_trimmed_mean_fraction_semantics(self):
+        """A coordinate-wise extreme client is trimmed EVERYWHERE
+        (fraction ~1); clustered honest clients far less."""
+        payloads, weights, accept = _payloads(k=8, outlier=5)
+        _, _, rep = robust_aggregate("trimmed_mean", payloads, weights,
+                                     accept, _fault("trimmed_mean"),
+                                     per_client=True)
+        susp = np.asarray(jax.device_get(rep.suspicion))
+        assert susp[5] == pytest.approx(1.0)
+        assert np.all(susp <= 1.0 + 1e-6)
+        assert np.mean(np.delete(susp, 5)) < susp[5]
+
+    def test_cohort_statistics_gauges(self):
+        """Identical updates: dispersion ~0, quantiles collapse to the
+        common norm; a flipped client moves dispersion up."""
+        k, d = 5, 6
+        u = np.tile(np.arange(1.0, d + 1.0, dtype=np.float32), (k, 1))
+        payloads = {"delta": jnp.asarray(u)}
+        w = jnp.ones((k,))
+        cs = cohort_statistics(payloads, w, jnp.ones((k,)))
+        nq, disp = jax.device_get((cs.norm_q, cs.dispersion))
+        expect = float(np.linalg.norm(u[0]))
+        np.testing.assert_allclose(nq, expect, rtol=1e-5)
+        assert disp == pytest.approx(0.0, abs=1e-5)
+        u2 = u.copy()
+        u2[2] = -u2[2]
+        cs2 = cohort_statistics({"delta": jnp.asarray(u2)}, w,
+                                jnp.ones((k,)))
+        assert float(jax.device_get(cs2.dispersion)) > 0.1
+
+    def test_non_candidates_score_zero(self):
+        payloads, weights, accept = _payloads(outlier=0)
+        accept = accept.at[4].set(0.0)
+        _, _, rep = robust_aggregate("median", payloads, weights,
+                                     accept, _fault("median"),
+                                     per_client=True)
+        susp, sel = jax.device_get((rep.suspicion, rep.sel_mask))
+        assert susp[4] == 0.0 and sel[4] == 0.0
+
+
+# -- engine parity across builder cells ----------------------------------
+
+def make_trainer(cohort, plane="device", sync_mode="sync",
+                 robust="mean", byz=0.0, telemetry_kw=None):
+    cfg = ExperimentConfig(
+        data=DataConfig(dataset="synthetic", synthetic_dim=10,
+                        batch_size=8, data_plane=plane),
+        federated=FederatedConfig(
+            federated=True, num_clients=8, num_comms=6,
+            online_client_rate=0.5, algorithm="fedavg",
+            sync_type="local_step", sync_mode=sync_mode),
+        model=ModelConfig(arch="logistic_regression"),
+        optim=OptimConfig(lr=0.1, weight_decay=0.0),
+        train=TrainConfig(local_step=2),
+        fault=FaultConfig(robust_agg=robust, byzantine_rate=byz,
+                          guard_updates=byz > 0),
+        telemetry=TelemetryConfig(cohort_stats=cohort,
+                                  **(telemetry_kw or {})),
+    ).finalize()
+    data = build_federated_data(cfg)
+    model = define_model(cfg, batch_size=cfg.data.batch_size)
+    if sync_mode == "async":
+        from fedtorch_tpu.async_plane import AsyncFederatedTrainer
+        cls = AsyncFederatedTrainer
+    else:
+        from fedtorch_tpu.parallel import FederatedTrainer
+        cls = FederatedTrainer
+    return cls(cfg, model, make_algorithm(cfg), data.train)
+
+
+def collect(trainer, n=4, seed=0):
+    server, clients = trainer.init_state(jax.random.key(seed))
+    traj, metrics = [], []
+    for _ in range(n):
+        server, clients, m = trainer.run_round(server, clients)
+        traj.append(np.concatenate([
+            np.ravel(x) for x in jax.tree.leaves(
+                jax.device_get(server.params))]))
+        metrics.append(m)
+    trainer.invalidate_stream()
+    return traj, metrics
+
+
+CELLS = [("device", "sync"), ("stream", "sync"),
+         ("device", "async"), ("stream", "async")]
+
+
+class TestEngineParity:
+    @pytest.mark.parametrize("plane,sync_mode", CELLS)
+    def test_bitwise_and_trace_once_across_cells(self, plane,
+                                                 sync_mode):
+        """Cohort stats on vs off: bitwise-identical trajectories and
+        exactly one trace, in every representative builder cell."""
+        ref, m_off = collect(make_trainer(False, plane, sync_mode))
+        trainer = make_trainer(True, plane, sync_mode)
+        server, clients = trainer.init_state(jax.random.key(0))
+        got = []
+        server, clients, m = trainer.run_round(server, clients)
+        got.append(np.concatenate([
+            np.ravel(x) for x in jax.tree.leaves(
+                jax.device_get(server.params))]))
+        with RecompilationSentinel() as s:
+            for _ in range(3):
+                server, clients, m = trainer.run_round(server, clients)
+                got.append(np.concatenate([
+                    np.ravel(x) for x in jax.tree.leaves(
+                        jax.device_get(server.params))]))
+        trainer.invalidate_stream()
+        assert sum(s.counts.values()) == 0
+        for a, b in zip(ref, got):
+            np.testing.assert_array_equal(a, b)
+        # off: zero extra outputs (the HLO-identity structural pin);
+        # on: the cohort vectors exist with the online-axis length
+        assert all(x.cohort_idx is None for x in m_off)
+        assert len(jax.tree.leaves(m_off[-1])) == \
+            PRE_COHORT_METRIC_LEAVES
+        k = trainer.buffer_size if sync_mode == "async" \
+            else trainer.k_online
+        led = jax.device_get(trainer.cohort_fetch_dev(m))
+        assert led["idx"].shape == (k,)
+        assert led["norm_q"].shape == (5,)
+        assert np.all(led["accept"] >= 0) and np.all(led["accept"] <= 1)
+        if sync_mode == "async":
+            assert np.all(led["staleness"] >= 0)
+        else:
+            assert np.all(led["staleness"] == 0)
+
+    def test_scan_dispatch_parity(self):
+        """The scan cell composes too: run_rounds with cohort stats on
+        matches the stats-off scan bitwise and carries stacked [R, k]
+        cohort vectors."""
+        def scan_traj(cohort):
+            tr = make_trainer(cohort)
+            server, clients = tr.init_state(jax.random.key(0))
+            server, clients, ms = tr.run_rounds(server, clients, 3)
+            return np.concatenate([
+                np.ravel(x) for x in jax.tree.leaves(
+                    jax.device_get(server.params))]), ms
+        p_off, ms_off = scan_traj(False)
+        p_on, ms_on = scan_traj(True)
+        np.testing.assert_array_equal(p_off, p_on)
+        assert ms_off.cohort_idx is None
+        assert ms_on.cohort_idx.shape == (3, 4)
+        assert ms_on.cohort_norm_q.shape == (3, 5)
+
+    def test_off_hlo_independent_of_host_knobs(self):
+        """The host-only federation knobs (anomaly threshold, ledger
+        budget) must not reach the lowered program; and the stats-off
+        lowering is identical across fresh trainer constructions."""
+        texts = []
+        for kw in ({}, {"anomaly_zscore": 2.0},
+                   {"ledger_sketch_budget": 128}):
+            tr = make_trainer(False, telemetry_kw=kw)
+            server, clients = tr.init_state(jax.random.key(0))
+            texts.append(tr._round_jit.lower(
+                server, clients, tr.data, tr.val_data).as_text())
+        assert texts[0] == texts[1] == texts[2]
+
+    def test_dispersion_rides_scalar_fetch(self):
+        trainer = make_trainer(True, robust="krum", byz=0.25)
+        server, clients = trainer.init_state(jax.random.key(0))
+        server, clients, m = trainer.run_round(server, clients)
+        sc = trainer.round_host_scalars(clients, m)
+        assert "cohort_dispersion" in sc
+        assert math.isfinite(sc["cohort_dispersion"])
+        off = make_trainer(False)
+        s2, c2 = off.init_state(jax.random.key(0))
+        s2, c2, m2 = off.run_round(s2, c2)
+        assert "cohort_dispersion" not in off.round_host_scalars(c2, m2)
+        assert off.cohort_fetch_dev(m2) is None
+
+
+# -- the per-client ledger -----------------------------------------------
+
+def _round_vectors(idx, online=None, accept=None, selected=None,
+                   suspicion=None, staleness=None):
+    k = len(idx)
+    ones = np.ones(k)
+    return {
+        "idx": np.asarray(idx, np.int32),
+        "online": ones if online is None else np.asarray(online, float),
+        "accept": ones if accept is None else np.asarray(accept, float),
+        "selected": ones if selected is None
+        else np.asarray(selected, float),
+        "suspicion": np.zeros(k) if suspicion is None
+        else np.asarray(suspicion, float),
+        "staleness": np.zeros(k) if staleness is None
+        else np.asarray(staleness, float),
+        "norm_q": np.zeros(5),
+    }
+
+
+class TestClientLedger:
+    def test_dense_counter_semantics(self, tmp_path):
+        led = ClientLedger(str(tmp_path), num_clients=6,
+                           flush_every=10 ** 9)
+        led.update(0, _round_vectors([0, 1, 2], online=[1, 1, 0],
+                                     accept=[1, 0, 0],
+                                     suspicion=[0.5, 2.0, 0.0]))
+        led.update(1, _round_vectors([1, 3, 5], staleness=[1, 2, 0],
+                                     suspicion=[3.0, 0.1, 0.2]))
+        d = led._dense
+        assert d["participation"].tolist() == [1, 2, 1, 1, 0, 1]
+        # client 1: round 0 survived but guard-rejected, round 1 clean
+        assert d["rejected"].tolist() == [0, 1, 0, 0, 0, 0]
+        # client 2 crashed in round 0: online only counts survivors
+        assert d["online"].tolist() == [1, 2, 0, 1, 0, 1]
+        assert d["suspicion"][1] == pytest.approx(5.0)
+        assert d["staleness"][3] == pytest.approx(2.0)
+        assert led.participation_estimate(1) == 2
+        assert led.stats()["ledger_tracked"] == 6.0
+
+    def test_flush_roundtrip_validate_and_ranking(self, tmp_path):
+        led = ClientLedger(str(tmp_path), num_clients=4,
+                           flush_every=10 ** 9)
+        led.update(0, _round_vectors([0, 2], suspicion=[0.1, 7.0]))
+        led.flush()
+        doc = read_client_ledger(str(tmp_path))
+        validate_client_ledger(doc)
+        assert doc["schema"] == LEDGER_SCHEMA
+        assert doc["mode"] == "dense" and doc["rounds"] == 1
+        assert suspicion_ranking(doc, top=1) == [(2, 7.0)]
+        # never-sampled clients do not pollute the ranking
+        assert {c for c, _ in suspicion_ranking(doc)} == {0, 2}
+
+    def test_determinism_under_seed(self, tmp_path):
+        docs = []
+        for sub in ("a", "b"):
+            d = tmp_path / sub
+            d.mkdir()
+            led = ClientLedger(str(d), num_clients=200_000,
+                               sketch_budget=512, seed=7,
+                               flush_every=10 ** 9)
+            rng = np.random.RandomState(3)
+            for r in range(5):
+                idx = rng.choice(200_000, size=16, replace=False)
+                led.update(r, _round_vectors(idx,
+                                             suspicion=rng.rand(16)))
+            led.flush()
+            doc = read_client_ledger(str(d))
+            doc.pop("created_unix"), doc.pop("updated_unix")
+            docs.append(doc)
+        assert docs[0] == docs[1]
+
+    def test_resume_adoption(self, tmp_path):
+        led = ClientLedger(str(tmp_path), num_clients=5,
+                           flush_every=10 ** 9)
+        led.update(0, _round_vectors([0, 1], suspicion=[1.0, 2.0]))
+        led.flush()
+        led2 = ClientLedger(str(tmp_path), num_clients=5,
+                            flush_every=10 ** 9)
+        assert led2.load_existing()
+        assert led2.rounds == 1
+        led2.update(1, _round_vectors([1], suspicion=[2.0]))
+        assert led2._dense["suspicion"][1] == pytest.approx(4.0)
+        # a different population refuses adoption (the fresh-run case)
+        led3 = ClientLedger(str(tmp_path), num_clients=9,
+                            flush_every=10 ** 9)
+        assert not led3.load_existing()
+        # corrupt files adopt nothing and never raise
+        with open(led.path, "w") as f:
+            f.write("{not json")
+        led4 = ClientLedger(str(tmp_path), num_clients=5)
+        assert not led4.load_existing()
+        # schema-VALID but content-corrupt (a string in a counter
+        # list): the parse runs inside the guard and commits nothing —
+        # an elastic restart must not die on a telemetry file
+        led.flush()
+        doc = json.load(open(led.path))
+        doc["counters"]["suspicion"][0] = "oops"
+        json.dump(doc, open(led.path, "w"))
+        led5 = ClientLedger(str(tmp_path), num_clients=5,
+                            flush_every=10 ** 9)
+        assert not led5.load_existing()
+        assert led5.rounds == 0
+        led5.update(0, _round_vectors([2]))  # still fully usable
+
+    def test_sketch_mode_bounded_memory_and_heavy_hitters(self,
+                                                          tmp_path):
+        C, budget = 1_000_000, 4096
+        led = ClientLedger(str(tmp_path), num_clients=C,
+                           sketch_budget=budget, flush_every=10 ** 9)
+        assert led.mode == "sketch"
+        rng = np.random.RandomState(0)
+        villain = 777_777
+        for r in range(30):
+            idx = rng.choice(C, size=32, replace=False)
+            idx[0] = villain
+            susp = rng.rand(32) * 0.5
+            susp[0] = 5.0
+            led.update(r, _round_vectors(idx, suspicion=susp))
+        # memory: O(budget), orders of magnitude under dense-at-C
+        dense_bytes = C * 8 * 7
+        assert led.memory_bytes() < dense_bytes // 10
+        assert led.tracked() <= led.top_k
+        # the persistent heavy hitter is tracked exactly and ranks top
+        led.flush()
+        doc = read_client_ledger(str(tmp_path))
+        validate_client_ledger(doc)
+        assert doc["mode"] == "sketch"
+        assert suspicion_ranking(doc, top=1)[0][0] == villain
+        assert led.participation_estimate(villain) >= 30
+
+    def test_write_failure_degrades_silently(self, tmp_path):
+        led = ClientLedger(str(tmp_path / "nope" / "deeper"),
+                           num_clients=4, flush_every=10 ** 9)
+        led.update(0, _round_vectors([0]))
+        led.flush()  # parent dir missing: counted, not raised
+        assert led.write_errors == 1
+
+
+# -- the anomaly detector ------------------------------------------------
+
+class TestAnomalyDetector:
+    def _rows(self, loss):
+        return {"loss": loss, "rejected": 0.0, "n_online": 4.0,
+                "staleness": 0.0}
+
+    def test_warmup_then_spike_then_rearm(self):
+        det = EwmaAnomalyDetector(zscore=4.0, warmup=5)
+        rng = np.random.RandomState(0)
+        for i in range(20):
+            out = det.observe(self._rows(1.0 + 0.01 * rng.randn()))
+            assert out == []
+        out = det.observe(self._rows(50.0))
+        assert len(out) == 1 and out[0]["field"] == "loss"
+        assert out[0]["zscore"] > 4.0
+        # still in excursion: no duplicate event
+        assert det.observe(self._rows(60.0)) == []
+        # back in band (the EWMA absorbed the spike; feed a value near
+        # the new mean), then a fresh spike re-fires
+        for _ in range(30):
+            det.observe(self._rows(1.0))
+        assert any(a["field"] == "loss"
+                   for a in det.observe(self._rows(80.0)))
+
+    def test_reject_rate_derived_and_detected(self):
+        det = EwmaAnomalyDetector(zscore=3.0, warmup=3)
+        for _ in range(10):
+            det.observe({"loss": 1.0, "rejected": 0.0, "n_online": 4.0})
+        row = {"loss": 1.0, "rejected": 4.0, "n_online": 4.0}
+        fields = [a["field"] for a in det.observe(row)]
+        assert "reject_rate" in fields
+
+    def test_nonfinite_is_anomalous_and_not_absorbed(self):
+        det = EwmaAnomalyDetector(zscore=6.0, warmup=2)
+        for _ in range(5):
+            det.observe(self._rows(1.0))
+        out = det.observe(self._rows(float("nan")))
+        assert out and out[0]["field"] == "loss"
+        # the NaN never entered the EWMA
+        assert math.isfinite(det.summary()["loss"]["ewma_mean"])
+
+    def test_event_cap(self):
+        det = EwmaAnomalyDetector(zscore=2.0, warmup=2,
+                                  max_events_per_field=2)
+        fired = 0
+        rng = np.random.RandomState(1)
+        for i in range(200):
+            det.observe(self._rows(1.0 + 0.01 * rng.randn()))
+            fired += len(det.observe(self._rows(100.0 * (i + 1))))
+        assert fired <= 2
+
+    def test_missing_fields_ignored(self):
+        det = EwmaAnomalyDetector()
+        assert det.observe({"round": 1}) == []
+        assert det.observe({"loss": "oops"}) == []
+
+
+# -- CLI e2e + report fixture -------------------------------------------
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "data", "report_run")
+
+
+class TestReportFederation:
+    def test_json_report_on_checked_in_fixture(self, capsys):
+        """Satellite 3: `fedtorch-tpu report --json` is machine-
+        readable CI fodder, pinned against a checked-in run dir."""
+        from fedtorch_tpu.cli import main
+        assert main(["report", FIXTURE, "--json"]) == 0
+        s = json.loads(capsys.readouterr().out)
+        assert s["rounds"] == 3
+        assert s["final_acc"] == pytest.approx(0.74)
+        assert s["phases"][0]["phase"] == "round"
+        fed = s["federation"]
+        assert fed["cohort"]["rounds"] == 2
+        assert fed["cohort"]["dispersion_last"] == pytest.approx(0.41)
+        assert fed["anomalies"] == {"loss": 1}
+        assert fed["ledger"]["mode"] == "dense"
+        assert fed["ledger"]["top_suspicion"][0] == [3, 9.5]
+        assert fed["staleness_hist"] == {"0": 5, "1": 3}
+        assert s["health"]["intent"] == "complete"
+
+    def test_text_report_renders_federation_section(self, capsys):
+        from fedtorch_tpu.cli import main
+        assert main(["report", FIXTURE]) == 0
+        out = capsys.readouterr().out
+        assert "federation plane" in out
+        assert "top suspicion" in out and "c3:9.50" in out
+        assert "anomalies: loss=1" in out
+
+    def test_cli_run_writes_ledger_and_cohort_rows(self, tmp_path):
+        """The whole chain under the real CLI loop: cohort gauges on
+        every row, a valid ledger on disk, rows schema-valid."""
+        from fedtorch_tpu.cli import run_experiment
+        from fedtorch_tpu.telemetry.schema import (
+            iter_jsonl, validate_metrics_row,
+        )
+        run_dir = str(tmp_path / "run")
+        cfg = ExperimentConfig(
+            data=DataConfig(dataset="synthetic", synthetic_dim=10,
+                            batch_size=8),
+            federated=FederatedConfig(
+                federated=True, num_clients=8, num_comms=4,
+                online_client_rate=0.5, algorithm="fedavg",
+                sync_type="local_step"),
+            model=ModelConfig(arch="logistic_regression"),
+            optim=OptimConfig(lr=0.1, weight_decay=0.0),
+            train=TrainConfig(local_step=2, eval_freq=4),
+            checkpoint=CheckpointConfig(run_dir=run_dir, debug=False),
+            telemetry=TelemetryConfig(cohort_stats=True),
+            fault=FaultConfig(byzantine_rate=0.25, guard_updates=True,
+                              robust_agg="krum", robust_trim_frac=0.3),
+        ).finalize()
+        run_experiment(cfg)
+        rows = [r for r in iter_jsonl(
+            os.path.join(run_dir, "metrics.jsonl")) if "schema" not in r]
+        assert len(rows) == 4
+        for r in rows:
+            validate_metrics_row(r)
+            assert "cohort_dispersion" in r
+            assert "cohort_norm_med" in r
+            assert "ledger_tracked" in r and r["ledger_tracked"] == 8.0
+        doc = read_client_ledger(run_dir)
+        assert doc["rounds"] == 4
+        assert sum(doc["counters"]["participation"]) == \
+            int(sum(r["n_online"] + r["dropped"] for r in rows))

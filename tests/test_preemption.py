@@ -508,6 +508,19 @@ class TestRunExperimentLifecycle:
         res = run_experiment(cfg, round_callback=cb)
         assert res["preempted"] and res["preempted_at_round"] == 2
         assert read_checkpoint_round(run_dir) == 3
+        # satellite (ISSUE 14): the staleness histogram must survive
+        # the drain — a snapshot lands on the drain path AND the
+        # run-end emission (which reads it before the stream teardown;
+        # it used to be lost to invalidate_stream ordering). Commits
+        # 0..2 each folded buffer_size updates, so the counts sum to
+        # commits x m.
+        from fedtorch_tpu.telemetry.schema import iter_jsonl
+        hist_evs = [e for e in iter_jsonl(
+            os.path.join(run_dir, "events.jsonl"))
+            if e.get("event") == "async.staleness_hist"]
+        assert {e["snapshot"] for e in hist_evs} >= {"drain", "final"}
+        for e in hist_evs:
+            assert sum(e["hist"].values()) == 3 * 1  # 3 commits x m=1
 
         res2 = run_experiment(
             _cli_cfg(run_dir, rounds=6,
