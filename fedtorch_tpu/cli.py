@@ -854,6 +854,14 @@ def run_experiment(cfg: ExperimentConfig,
     loop_raised = False
     byz_attack_seen = False
     host_retries_seen = 0
+    # round-wall critical path (telemetry/critical_path.py): per-round
+    # overlap efficiency from the DELTAS of the producer's cumulative
+    # gather/H2D/wait gauges — pure host float math over values the
+    # row already carries, zero extra device syncs
+    from fedtorch_tpu.telemetry.critical_path import (
+        StreamOverlapTracker,
+    )
+    overlap_tracker = StreamOverlapTracker()
     try:
         for r in range(start_round, cfg.federated.num_comms):
             timer.new_round()
@@ -1075,6 +1083,12 @@ def run_experiment(cfg: ExperimentConfig,
                 ledger.update(r, led)
                 row.update(ledger.stats())
             row.update(trainer.telemetry_gauges())
+            overlap_eff = overlap_tracker.observe(row)
+            if overlap_eff is not None:
+                # stream plane: the fraction of this round's producer
+                # gather+H2D wall hidden under device compute — the
+                # number ROADMAP item 1's STREAM_AB 1.15x gap needs
+                row["overlap_efficiency"] = overlap_eff
             if cost_capture is not None:
                 # measured MFU + HBM watermark pair — empty until the
                 # capture above succeeded, host-side either way
@@ -1312,6 +1326,25 @@ def main(argv=None):
         # initializes jax
         from fedtorch_tpu.tools.report import main as report_main
         return report_main(argv[1:])
+    if argv and argv[0] == "watch":
+        # `fedtorch-tpu watch <run_dir>` — live console over a
+        # running run's health/metrics/events (docs/observability.md
+        # "Operating and comparing runs"); stdlib-only, never
+        # initializes jax; one-shot snapshot on non-tty
+        from fedtorch_tpu.tools.watch import main as watch_main
+        return watch_main(argv[1:])
+    if argv and argv[0] == "compare":
+        # `fedtorch-tpu compare A B [--gate gates.json]` — noise-aware
+        # run-dir diff with regression gating (exit 1 on a gated
+        # regression); stdlib-only, never initializes jax
+        from fedtorch_tpu.tools.compare import main as compare_main
+        return compare_main(argv[1:])
+    if argv and argv[0] == "runs":
+        # `fedtorch-tpu runs <root>` — index run dirs into
+        # runs_index.json and list/filter them; stdlib-only, never
+        # initializes jax
+        from fedtorch_tpu.telemetry.runs import main as runs_main
+        return runs_main(argv[1:])
     if argv and argv[0] == "supervise":
         # `fedtorch-tpu supervise [opts] -- <training command>` — the
         # per-host auto-restart harness (robustness/harness.py):

@@ -152,6 +152,8 @@ _EMIT_SITE_FILES = (
     "fedtorch_tpu/robustness/host_chaos.py",
     "fedtorch_tpu/telemetry/costs.py",
     "fedtorch_tpu/telemetry/ledger.py",
+    # the writer itself stamps every row (seq + t, ops plane)
+    "fedtorch_tpu/telemetry/metrics.py",
 )
 
 

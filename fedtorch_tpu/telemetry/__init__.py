@@ -30,11 +30,15 @@ Library-code usage (no Telemetry object in scope)::
 from __future__ import annotations
 
 from fedtorch_tpu.telemetry.anomaly import (  # noqa: F401
-    ANOMALY_FIELDS, EwmaAnomalyDetector,
+    ANOMALY_FIELDS, EwmaAnomalyDetector, replay_anomalies,
 )
 from fedtorch_tpu.telemetry.costs import (  # noqa: F401
     PROGRAM_COSTS_SCHEMA, ProgramCostCapture, program_costs_path,
     read_program_costs, resolve_peak_tflops, validate_program_costs,
+)
+from fedtorch_tpu.telemetry.critical_path import (  # noqa: F401
+    StreamOverlapTracker, overlap_efficiency, overlap_summary,
+    round_wall_decomposition,
 )
 from fedtorch_tpu.telemetry.ledger import (  # noqa: F401
     LEDGER_SCHEMA, ClientLedger, ledger_path, read_client_ledger,
@@ -49,8 +53,9 @@ from fedtorch_tpu.telemetry.runtime import (  # noqa: F401
 )
 from fedtorch_tpu.telemetry.schema import (  # noqa: F401
     EVENTS_SCHEMA, HEALTH_INTENTS, HEALTH_SCHEMA, METRICS_OPTIONAL,
-    METRICS_REQUIRED, METRICS_SCHEMA, iter_jsonl, read_header,
-    validate_health, validate_metrics_row,
+    METRICS_REQUIRED, METRICS_SCHEMA, count_restarts, iter_jsonl,
+    load_jsonl, read_header, stitch_rows, validate_health,
+    validate_metrics_row,
 )
 from fedtorch_tpu.telemetry.spans import (  # noqa: F401
     NULL_SPAN, SpanRecorder,

@@ -120,3 +120,29 @@ class EwmaAnomalyDetector:
                 "ewma_std": round(math.sqrt(max(var, 0.0)), 6),
                 "events": emitted}
             for f, (n, mean, var, _exc, emitted) in self._state.items()}
+
+
+def replay_anomalies(run_dir: str, zscore: float = 6.0,
+                     **detector_kwargs) -> Dict:
+    """Offline anomaly replay: run a FRESH detector over a recorded
+    run dir's ``metrics.jsonl`` (e.g. to re-judge a run at a different
+    threshold than the live one, or a run that had the detector off).
+    Torn-tail tolerant and restart-stitched via the shared
+    ``telemetry.schema`` loader — a truncated final line is counted,
+    never raises. Returns ``{"anomalies": [per-row records with the
+    round attached], "summary": detector state, "rows": n,
+    "torn_lines": n}``."""
+    import os
+
+    from fedtorch_tpu.telemetry.schema import load_jsonl, stitch_rows
+
+    _header, records, torn = load_jsonl(
+        os.path.join(run_dir, "metrics.jsonl"))
+    rows = stitch_rows(records)
+    det = EwmaAnomalyDetector(zscore=zscore, **detector_kwargs)
+    out: List[Dict] = []
+    for row in rows:
+        for a in det.observe(row):
+            out.append({"round": row.get("round"), **a})
+    return {"anomalies": out, "summary": det.summary(),
+            "rows": len(rows), "torn_lines": torn}

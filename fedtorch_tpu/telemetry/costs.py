@@ -416,6 +416,14 @@ class ProgramCostCapture:
         if flops and round_s > 0:
             out["model_flops_utilization"] = flops / (
                 round_s * self.peak_tflops * 1e12 * self.num_devices)
+            # the round-wall critical path's device side
+            # (telemetry/critical_path.py): the FLOPs-at-peak floor of
+            # device-busy time, and the wall share it does NOT explain
+            # — host phases + dispatch gap + sub-peak MXU occupancy
+            floor = flops / (self.peak_tflops * 1e12 * self.num_devices)
+            out["round_device_min_s"] = floor
+            out["round_host_frac"] = min(
+                max(1.0 - floor / round_s, 0.0), 1.0)
         peak = self._primary.get("peak_hbm_bytes")
         if peak is not None:
             out["hbm_program_peak_bytes"] = float(peak)

@@ -89,6 +89,17 @@ class JsonlWriter:
                 if f.tell() == 0:
                     f.write(json.dumps(self._header) + "\n")
                     f.flush()
+                else:
+                    # appending to an existing file (elastic restart):
+                    # a crash may have left a torn final line with NO
+                    # newline — writing our first row directly after
+                    # it would merge the two into one unparseable line
+                    # and lose BOTH (the stale pre-crash row would
+                    # then win the restart stitch). A defensive
+                    # newline isolates the torn bytes; readers skip
+                    # blank lines.
+                    f.write("\n")
+                    f.flush()
                 self._f = f
             return self._f
 
@@ -115,12 +126,23 @@ class JsonlWriter:
         if self.degraded:
             self.dropped_rows += 1
             return
-        try:
-            line = json.dumps(row) + "\n"
-        except (TypeError, ValueError):
-            self.write_errors += 1
-            return
+        # stamp every row with the per-writer monotonic sequence and a
+        # wall-clock time (events already carry their own `t`): an
+        # elastic restart appends a fresh writer to the SAME file, so
+        # a mid-stream seq drop marks the restart boundary and `t`
+        # orders rows across it — compare/watch stitch unambiguously
+        # (telemetry.schema count_restarts/stitch_rows). Stamped under
+        # the mutex so seq order matches buffer order.
+        row = dict(row)
+        if "t" not in row:
+            row["t"] = time.time()
         with self._mutex:
+            row["seq"] = self.rows
+            try:
+                line = json.dumps(row) + "\n"
+            except (TypeError, ValueError):
+                self.write_errors += 1
+                return
             self._buf.append(line)
             self.rows += 1
             if len(self._buf) > self.MAX_BUFFER_ROWS:

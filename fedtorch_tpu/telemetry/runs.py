@@ -1,0 +1,260 @@
+"""Cross-run registry: index run dirs into ``runs_index.json``.
+
+A capture season leaves dozens of run dirs (telemetry captures,
+A/B legs, chaos drills, elastic-restart trees) that until now were
+compared by eyeball over ad-hoc ``ls``+``report`` loops. The registry
+makes the population a queryable document: one schema-versioned record
+per run dir — config header, final metrics, round rate, event/anomaly
+counts, program-cost summary, ledger top-suspicion, health outcome,
+torn-line/restart counts — written atomically to ``<root>/
+runs_index.json`` and listed/filtered by ``fedtorch-tpu runs``.
+
+Stdlib-only and NEVER imports jax (the ``tools/report.py`` rule,
+asserted in tests): a monitor box indexes a mounted artifact tree.
+Broken run dirs become records with an ``error`` field, not
+exceptions — an index that dies on one torn dir indexes nothing.
+
+Usage::
+
+    fedtorch-tpu runs <root> [--json] [--filter k=v ...] [--no-write]
+    python -m fedtorch_tpu.telemetry.runs <root>
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+RUNS_INDEX_SCHEMA = "fedtorch_tpu.runs_index/v1"
+RUNS_INDEX_NAME = "runs_index.json"
+
+# any of these makes a directory a run dir (metrics-first; health-only
+# covers a run that died before its first flush; record0 covers the
+# legacy pre-telemetry trees the report tool still renders)
+RUN_DIR_MARKERS = ("metrics.jsonl", "health.json", "record0")
+
+
+def is_run_dir(path: str) -> bool:
+    return os.path.isdir(path) and any(
+        os.path.exists(os.path.join(path, m)) for m in RUN_DIR_MARKERS)
+
+
+def scan_run_dirs(root: str) -> List[str]:
+    """Run dirs under ``root``: the root itself when it IS one, else
+    its direct children (sorted) — the layout every capture script
+    produces (``artifacts/<run>``, ``checkpoint/<run>``)."""
+    if is_run_dir(root):
+        return [root]
+    out = []
+    try:
+        entries = sorted(os.listdir(root))
+    except OSError:
+        return []
+    for name in entries:
+        p = os.path.join(root, name)
+        if is_run_dir(p):
+            out.append(p)
+    return out
+
+
+def index_run(run_dir: str) -> Dict:
+    """One registry record. Absorbs every per-dir failure into an
+    ``error`` field: the index must survive any single broken dir."""
+    rec: Dict = {"name": os.path.basename(os.path.normpath(run_dir)),
+                 "path": run_dir}
+    try:
+        from fedtorch_tpu.tools.report import summarize
+        s = summarize(run_dir)
+    except Exception as e:  # noqa: BLE001 — record, don't raise
+        rec["error"] = f"{type(e).__name__}: {e}"[:200]
+        return rec
+    rec["source"] = s.get("source")
+    rec["meta"] = s.get("meta") or {}
+    rec["rounds"] = s.get("rounds", 0)
+    for key in ("first_round", "last_round", "round_s_mean_steady",
+                "rounds_per_s_steady", "comm_bytes_total",
+                "final_loss", "final_acc", "final_test_top1",
+                "best_test_top1", "torn_lines", "restarts"):
+        if key in s:
+            rec[key] = s[key]
+    h = s.get("health")
+    if h:
+        rec["health"] = {"intent": h.get("intent"),
+                         "round": h.get("round"),
+                         "updated_unix": h.get("updated_unix")}
+    ev = s.get("events") or {}
+    if ev:
+        rec["events_total"] = int(sum(
+            v for k, v in ev.items() if isinstance(v, (int, float))))
+        rec["anomalies"] = int(ev.get("anomaly.detected", 0))
+    fed = s.get("federation") or {}
+    led = fed.get("ledger") or {}
+    if led.get("top_suspicion"):
+        cid, sus = led["top_suspicion"][0]
+        rec["ledger_top_suspicion"] = [cid, sus]
+    ov = s.get("overlap")
+    if ov:
+        rec["overlap_efficiency_mean"] = ov["mean"]
+    gauges = s.get("last_gauges") or {}
+    cp = s.get("critical_path") or {}
+    pc = s.get("program_costs")
+    if pc is not None:
+        # already read + validated by summarize — no second parse
+        rec["program_costs"] = {
+            "primary": pc.get("primary"), "backend": pc.get("backend"),
+            "flops": pc.get("flops"),
+            "peak_hbm_bytes": pc.get("peak_hbm_bytes"),
+        }
+    for key in ("model_flops_utilization", "hbm_program_peak_bytes"):
+        if key in gauges:
+            rec[key] = gauges[key]
+    if "host_frac" in cp:
+        rec["round_host_frac"] = cp["host_frac"]
+    return rec
+
+
+def build_index(root: str, write: bool = True,
+                out_path: Optional[str] = None) -> Dict:
+    """The whole index document; atomically written to
+    ``<root>/runs_index.json`` unless ``write`` is False."""
+    doc = {
+        "schema": RUNS_INDEX_SCHEMA,
+        "created_unix": time.time(),
+        "root": root,
+        "runs": [index_run(d) for d in scan_run_dirs(root)],
+    }
+    if write:
+        path = out_path or os.path.join(root, RUNS_INDEX_NAME)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=2, sort_keys=True)
+            os.replace(tmp, path)
+        except OSError as e:
+            # a read-only artifact mount still lists; note, don't die
+            doc["write_error"] = str(e)
+    return doc
+
+
+def validate_runs_index(doc: Dict) -> None:
+    if doc.get("schema") != RUNS_INDEX_SCHEMA:
+        raise ValueError(
+            f"runs_index schema {doc.get('schema')!r} != "
+            f"{RUNS_INDEX_SCHEMA!r}")
+    if not isinstance(doc.get("runs"), list):
+        raise ValueError("runs_index 'runs' must be a list of records")
+
+
+def load_index(root_or_path: str) -> Dict:
+    path = root_or_path
+    if os.path.isdir(path):
+        path = os.path.join(path, RUNS_INDEX_NAME)
+    with open(path) as f:
+        doc = json.load(f)
+    validate_runs_index(doc)
+    return doc
+
+
+def _lookup(rec: Dict, dotted: str):
+    cur = rec
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def match_filters(rec: Dict, filters: List[str]) -> bool:
+    """Each filter is ``dotted.key=value``: numeric values compare
+    ==, strings compare case-insensitive substring (so
+    ``meta.algorithm=fed`` matches fedavg and fedadam). A record
+    missing the key does not match."""
+    for f in filters:
+        key, _, want = f.partition("=")
+        have = _lookup(rec, key.strip())
+        if have is None:
+            return False
+        want = want.strip()
+        if isinstance(have, bool):
+            if want.lower() not in (str(have).lower(), str(int(have))):
+                return False
+        elif isinstance(have, (int, float)):
+            try:
+                if float(want) != float(have):
+                    return False
+            except ValueError:
+                return False
+        elif want.lower() not in str(have).lower():
+            return False
+    return True
+
+
+def _fmt(v, width: int) -> str:
+    if v is None:
+        s = "-"
+    elif isinstance(v, float):
+        s = f"{v:.4g}"
+    else:
+        s = str(v)
+    return s[:width].ljust(width)
+
+
+def render_index(doc: Dict, runs: Optional[List[Dict]] = None) -> str:
+    runs = doc["runs"] if runs is None else runs
+    lines = [f"runs index: {doc.get('root')}  ({len(runs)} run(s), "
+             f"schema {doc.get('schema')})"]
+    header = ("name", "rounds", "intent", "acc", "test_top1",
+              "r/s", "mfu", "ovl", "anom", "torn")
+    widths = (24, 6, 10, 7, 9, 8, 7, 5, 5, 5)
+    lines.append("  ".join(h.ljust(w) for h, w in zip(header, widths)))
+    for r in runs:
+        if "error" in r:
+            lines.append(f"{_fmt(r.get('name'), 24)}  "
+                         f"unreadable: {r['error']}")
+            continue
+        h = r.get("health") or {}
+        row = (r.get("name"), r.get("rounds"), h.get("intent"),
+               r.get("final_acc"), r.get("final_test_top1"),
+               r.get("rounds_per_s_steady"),
+               r.get("model_flops_utilization"),
+               r.get("overlap_efficiency_mean"),
+               r.get("anomalies"), r.get("torn_lines"))
+        lines.append("  ".join(_fmt(v, w) for v, w in zip(row, widths)))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="fedtorch-tpu runs",
+        description="Index run dirs under a root into runs_index.json "
+                    "and list/filter them (docs/observability.md "
+                    "'Operating and comparing runs')")
+    p.add_argument("root", help="directory holding run dirs (or one "
+                                "run dir)")
+    p.add_argument("--filter", action="append", default=[],
+                   metavar="KEY=VALUE",
+                   help="dotted-key filter, repeatable (e.g. "
+                        "meta.algorithm=fedavg health.intent=complete)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="print the (filtered) index document as JSON")
+    p.add_argument("--no-write", action="store_true",
+                   help="list without (re)writing runs_index.json")
+    args = p.parse_args(argv)
+    if not os.path.isdir(args.root):
+        print(f"runs: {args.root}: not a directory", file=sys.stderr)
+        return 2
+    doc = build_index(args.root, write=not args.no_write)
+    runs = [r for r in doc["runs"] if match_filters(r, args.filter)]
+    if args.as_json:
+        out = dict(doc, runs=runs)
+        print(json.dumps(out, indent=2, sort_keys=True, default=str))
+    else:
+        print(render_index(doc, runs))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

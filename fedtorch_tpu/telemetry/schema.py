@@ -13,7 +13,7 @@ able to parse a run dir without initializing JAX.
 from __future__ import annotations
 
 import json
-from typing import Dict, Iterator, Optional
+from typing import Dict, Iterator, List, Optional, Tuple
 
 # bump ONLY on breaking changes (renamed/retyped required fields);
 # adding optional fields is backward-compatible and needs no bump
@@ -39,6 +39,13 @@ METRICS_REQUIRED = {
 # Optional gauge groups (absent when the subsystem is off). Names are
 # the catalog rendered in docs/observability.md.
 METRICS_OPTIONAL = {
+    # row stamps (telemetry/metrics.py JsonlWriter — every row since
+    # the ops plane; optional so pre-ops run dirs stay valid): `seq`
+    # restarts at 0 per writer, so a mid-file seq drop marks an
+    # elastic-restart boundary and `t` orders rows across it —
+    # cross-restart stitching in compare/watch is unambiguous
+    "seq": "monotonic per-writer row sequence (resets on restart)",
+    "t": "wall-clock emit time (unix seconds)",
     # robustness counters (chaos/guards; 0-valued when enabled but calm)
     "dropped": "chaos-crashed clients masked out of aggregation",
     "stragglers": "step-budget cuts (async: delayed dispatches)",
@@ -65,6 +72,11 @@ METRICS_OPTIONAL = {
     "stream_gather_s": "producer schedule+pack wall (total)",
     "stream_h2d_s": "producer device_put dispatch wall (total)",
     "stream_produced": "feeds produced since (re)start",
+    # round-wall critical path (telemetry/critical_path.py;
+    # docs/observability.md "Operating and comparing runs")
+    "overlap_efficiency": "fraction of this round's producer "
+                          "gather+H2D wall hidden under device "
+                          "compute (stream plane)",
     # async commit plane (trainer.schedule_stats + staleness histogram)
     "async_dispatches": "client dispatches simulated so far",
     "async_stragglers": "tail-delayed dispatches so far",
@@ -104,6 +116,12 @@ METRICS_OPTIONAL = {
                               "memory watermark (memory_analysis)",
     "hbm_live_bytes": "live jax.Array bytes at row time "
                       "(live_buffer_summary — metadata walk, no sync)",
+    "round_device_min_s": "FLOPs-at-peak device-time floor of the "
+                          "captured primary program (the analytic "
+                          "lower bound on device-busy seconds)",
+    "round_host_frac": "1 - round_device_min_s/round_s — the round-"
+                       "wall share NOT explained by the device floor "
+                       "(host phases, dispatch gap, sub-peak MXU)",
     # federation-plane cohort statistics (telemetry.cohort_stats;
     # robustness/aggregators.py:cohort_statistics — docs/
     # observability.md "Federation plane")
@@ -183,12 +201,15 @@ def validate_health(doc: Dict) -> None:
                          f"(expected one of {HEALTH_INTENTS})")
 
 
-def iter_jsonl(path: str) -> Iterator[Dict]:
+def iter_jsonl(path: str, on_torn=None) -> Iterator[Dict]:
     """Yield one dict per line; the header line (``{"schema": ...}``)
-    is included — callers filter on the ``"schema"`` key. A trailing
-    partial line (crash mid-append) is skipped, not fatal: every
-    COMPLETE line was written atomically enough (single ``write`` of a
-    line under append mode) to parse."""
+    is included — callers filter on the ``"schema"`` key. A torn
+    partial line (crash/preemption mid-append — normally the file's
+    last line, but an elastic restart can bury one mid-file) is
+    skipped, not fatal: every COMPLETE line was written atomically
+    enough (single ``write`` of a line under append mode) to parse.
+    ``on_torn(line)``, when given, is called once per skipped line so
+    readers surface a COUNTED warning instead of silently dropping."""
     with open(path) as f:
         for line in f:
             line = line.strip()
@@ -197,8 +218,67 @@ def iter_jsonl(path: str) -> Iterator[Dict]:
             try:
                 yield json.loads(line)
             except json.JSONDecodeError:
-                # only legal for the file's last, torn line
+                if on_torn is not None:
+                    on_torn(line)
                 continue
+
+
+def load_jsonl(path: str) -> Tuple[Optional[Dict], List[Dict], int]:
+    """``(header, records, torn_lines)`` — the whole-file form every
+    offline reader (report / compare / runs registry / anomaly replay)
+    shares, so torn-tail tolerance and its counted warning cannot be
+    implemented five slightly-different ways. ``header`` is the first
+    record carrying a ``schema`` key (None for headerless files);
+    later ``schema`` records (an elastic restart appending a fresh
+    header) are dropped from ``records`` too."""
+    torn = [0]
+
+    def _count(_line: str) -> None:
+        torn[0] += 1
+
+    header: Optional[Dict] = None
+    records: List[Dict] = []
+    for rec in iter_jsonl(path, on_torn=_count):
+        if "schema" in rec:
+            if header is None:
+                header = rec
+            continue
+        records.append(rec)
+    return header, records, torn[0]
+
+
+def count_restarts(records: List[Dict]) -> int:
+    """Elastic-restart boundaries in a stitched row stream: each time
+    the per-writer ``seq`` stamp drops, a fresh writer appended to the
+    same file. Rows without ``seq`` (pre-ops runs) contribute no
+    boundaries."""
+    restarts = 0
+    prev = None
+    for rec in records:
+        seq = rec.get("seq")
+        if not isinstance(seq, int) or isinstance(seq, bool):
+            continue
+        # within one writer seq is STRICTLY increasing, so a repeat is
+        # a boundary too (a pre-crash writer that flushed exactly one
+        # row hands seq 0 to the restart's first seq 0)
+        if prev is not None and seq <= prev:
+            restarts += 1
+        prev = seq
+    return restarts
+
+
+def stitch_rows(records: List[Dict], key: str = "round") -> List[Dict]:
+    """Cross-restart stitching: an elastic restart resumes from the
+    last durable checkpoint, so the re-run rounds appear twice in the
+    appended stream. The LAST occurrence of each ``key`` wins (file
+    order — the re-run row supersedes the pre-crash one), and the
+    result is sorted by ``key``. Rows missing ``key`` are dropped."""
+    by_key: Dict = {}
+    for rec in records:
+        k = rec.get(key)
+        if isinstance(k, (int, float)) and not isinstance(k, bool):
+            by_key[k] = rec
+    return [by_key[k] for k in sorted(by_key)]
 
 
 def read_header(path: str) -> Optional[Dict]:

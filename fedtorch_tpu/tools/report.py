@@ -26,7 +26,10 @@ import os
 import sys
 from typing import Dict, List, Optional
 
-from fedtorch_tpu.telemetry import iter_jsonl, read_health
+from fedtorch_tpu.telemetry import read_health
+from fedtorch_tpu.telemetry.schema import (
+    count_restarts, load_jsonl, stitch_rows,
+)
 
 
 def _fmt_bytes(n: float) -> str:
@@ -46,19 +49,23 @@ def load_run(run_dir: str) -> Dict:
     """Structured view of one run dir: telemetry rows when present,
     the ``record0`` regex fallback otherwise."""
     out: Dict = {"run_dir": run_dir, "source": None, "meta": {},
-                 "rows": [], "events": [], "health": None}
+                 "rows": [], "events": [], "health": None,
+                 "torn_lines": 0, "restarts": 0}
     mpath = os.path.join(run_dir, "metrics.jsonl")
     if os.path.exists(mpath):
         out["source"] = "telemetry"
-        for rec in iter_jsonl(mpath):
-            if "schema" in rec:
-                out["meta"] = rec.get("run", {}) or {}
-            else:
-                out["rows"].append(rec)
+        # torn-tail tolerant + restart-stitched (telemetry.schema): a
+        # crash's truncated final line is COUNTED, not fatal, and an
+        # elastic restart's re-run rounds dedupe (last write wins)
+        header, records, torn = load_jsonl(mpath)
+        out["meta"] = (header or {}).get("run", {}) or {}
+        out["rows"] = stitch_rows(records)
+        out["restarts"] = count_restarts(records)
+        out["torn_lines"] = torn
         epath = os.path.join(run_dir, "events.jsonl")
         if os.path.exists(epath):
-            out["events"] = [r for r in iter_jsonl(epath)
-                             if "schema" not in r]
+            _eh, out["events"], etorn = load_jsonl(epath)
+            out["torn_lines"] += etorn
         out["health"] = read_health(run_dir)
         return out
     # legacy fallback: regex-parse the record file (reference parity)
@@ -99,15 +106,20 @@ def _phase_table(rows: List[Dict]) -> List[tuple]:
     return [(n, t, t / whole, c) for n, t, c in totals]
 
 
-def summarize(run_dir: str) -> Dict:
+def summarize(run_dir: str, run: Optional[Dict] = None) -> Dict:
     """The machine-readable summary the text report renders (tests
-    assert on this dict, not on formatting)."""
-    run = load_run(run_dir)
+    assert on this dict, not on formatting). ``run`` accepts an
+    already-``load_run``-ed dict so callers that need both (the
+    compare tool) don't parse the JSONL files twice."""
+    if run is None:
+        run = load_run(run_dir)
     rows = run["rows"]
     if not rows:
         return {"run_dir": run_dir, "source": run["source"],
                 "rounds": 0, "meta": run["meta"],
-                "health": run["health"]}
+                "health": run["health"],
+                "torn_lines": run.get("torn_lines", 0),
+                "restarts": run.get("restarts", 0)}
     round_s = [r["round_s"] for r in rows]
     total = sum(round_s)
     # steady-state rate excludes the first round (it pays compilation);
@@ -135,6 +147,8 @@ def summarize(run_dir: str) -> Dict:
         "health": run["health"],
         "events": {},
         "last_gauges": {},
+        "torn_lines": run.get("torn_lines", 0),
+        "restarts": run.get("restarts", 0),
     }
     if evals:
         s["final_test_top1"] = evals[-1]["test_top1"]
@@ -214,10 +228,42 @@ def summarize(run_dir: str) -> Dict:
         fed["ledger_error"] = str(e)
     if fed:
         s["federation"] = fed
+    # round-wall critical path (telemetry/critical_path.py;
+    # docs/observability.md "Operating and comparing runs"): the
+    # stream plane's overlap efficiency and the host/device wall
+    # decomposition against the captured program-cost device floor
+    from fedtorch_tpu.telemetry import critical_path
+    ov = critical_path.overlap_summary(rows)
+    if ov is not None:
+        s["overlap"] = ov
+    costs_doc = None
+    try:
+        from fedtorch_tpu.telemetry.costs import read_program_costs
+        costs_doc = read_program_costs(run_dir)
+    except (ValueError, OSError):
+        pass  # a broken capture already surfaces via report --device
+    dec = critical_path.round_wall_decomposition(rows, costs_doc)
+    if dec is not None:
+        s["critical_path"] = dec
+    if costs_doc is not None:
+        # the program-cost summary compare/runs key on — surfaced here
+        # so they don't re-read and re-validate the document
+        primary = (costs_doc.get("programs") or {}).get(
+            costs_doc.get("primary")) or {}
+        s["program_costs"] = {
+            "primary": costs_doc.get("primary"),
+            "backend": costs_doc.get("backend"),
+            "flops": primary.get("flops"),
+            "bytes_accessed": primary.get("bytes_accessed"),
+            "peak_hbm_bytes": primary.get("peak_hbm_bytes")}
     last = rows[-1]
     for key in sorted(last):
         if key.startswith(("stream_", "async_", "ckpt_", "sup_",
-                           "cohort_", "ledger_")):
+                           "cohort_", "ledger_")) \
+                or key in ("overlap_efficiency", "round_device_min_s",
+                           "round_host_frac",
+                           "model_flops_utilization",
+                           "hbm_program_peak_bytes", "hbm_live_bytes"):
             s["last_gauges"][key] = last[key]
     return s
 
@@ -248,11 +294,33 @@ def render(run_dir: str) -> str:
            if "final_test_top1" in s else "")
     lines.append(f"{acc}final train loss: {s['final_loss']:.4f}  "
                  f"acc: {s['final_acc']:.4f}")
+    if s.get("torn_lines") or s.get("restarts"):
+        lines.append(
+            f"warning: {s.get('torn_lines', 0)} torn JSONL line(s) "
+            f"skipped; {s.get('restarts', 0)} elastic-restart "
+            "boundar(ies) stitched (last write per round wins)")
     if s["phases"]:
         lines.append("phase breakdown (host wall, summed over rounds):")
         for name, t, share, count in s["phases"]:
             lines.append(f"  {name:<13} {_fmt_s(t):>10}  "
                          f"{share * 100:5.1f}%  ({count} rounds)")
+    cp = s.get("critical_path") or {}
+    if "device_floor_s" in cp:
+        lines.append(
+            "critical path (mean steady round): wall "
+            f"{_fmt_s(cp['round_s_mean'])} = device floor "
+            f"{_fmt_s(cp['device_floor_s'])} "
+            f"({cp['device_floor_frac'] * 100:.1f}%) + host/dispatch "
+            f"{_fmt_s(cp['unattributed_s'])} "
+            f"({cp['host_frac'] * 100:.1f}%)")
+    ov = s.get("overlap") or {}
+    if ov:
+        lines.append(
+            f"stream overlap: efficiency mean {ov['mean']:.2f} "
+            f"(min {ov['min']:.2f}, last {ov['last']:.2f}) over "
+            f"{ov['rounds']} rounds; producer wall "
+            f"{_fmt_s(ov['producer_wall_s'])}, exposed "
+            f"{ov['exposed_frac'] * 100:.1f}%")
     rob = s.get("robustness") or {}
     if rob:
         lines.append("robustness (chaos/guards/byzantine — summed "

@@ -1,0 +1,374 @@
+"""``fedtorch-tpu watch <run_dir>``: live console over a running run.
+
+Tails the run dir's ``health.json`` + ``metrics.jsonl`` +
+``events.jsonl`` incrementally (byte-offset resume, no re-parse of the
+whole file per tick) and renders the operator loop's live questions:
+round rate and ETA, loss/accuracy sparklines, health intent and
+time-since-progress, stream overlap efficiency, and the
+retry/degraded/anomaly counters. On a non-tty (CI, a pipe) — or with
+``--once`` — it degrades to a one-shot snapshot and exits.
+
+Robust by construction against everything a live run dir does:
+
+* **torn tails** — a partial final line stays buffered until the
+  writer completes it; a line that was durably torn (crash mid-append,
+  then more rows after restart) is skipped with a counted warning;
+* **atomic-replace rotation** — ``health.json`` is re-read whole every
+  tick (it is atomically replaced, never appended); a truncated or
+  rotated JSONL file resets the tail offset instead of mis-seeking;
+* **elastic restarts** — the same run dir is appended to by a fresh
+  writer; the per-writer ``seq`` stamp drop marks the boundary, re-run
+  rounds dedupe (last write wins), and the restart count is displayed.
+
+Keybinds (tty): ``q`` quits; Ctrl-C quits. The watch exits on its own
+once the health intent goes terminal (complete/error/preempted/
+stalled), after a final render.
+
+Stdlib-only, never imports jax (asserted in tests, like ``report``).
+
+Usage::
+
+    fedtorch-tpu watch <run_dir> [--interval S] [--once]
+    python -m fedtorch_tpu.tools.watch <run_dir>
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+from fedtorch_tpu.telemetry.critical_path import StreamOverlapTracker
+from fedtorch_tpu.telemetry.health import read_health
+from fedtorch_tpu.telemetry.schema import HEALTH_INTENTS
+
+TERMINAL_INTENTS = ("complete", "error", "preempted", "stalled")
+assert set(TERMINAL_INTENTS) <= set(HEALTH_INTENTS)
+SPARK_CHARS = "▁▂▃▄▅▆▇█"
+
+
+class JsonlTail:
+    """Incremental append-only JSONL reader.
+
+    Byte-offset based: each :meth:`poll` reads only what was appended
+    since the last one. A partial final line (the writer is mid-
+    append, or a crash tore it) is held in the carry buffer — it is
+    only counted ``torn`` once later bytes prove it will never parse
+    (a newline arrived and the line still isn't JSON). A file that
+    shrank (rotation, truncation) resets the offset and re-reads."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.torn = 0
+        self._pos = 0
+        self._carry = b""
+
+    def poll(self) -> List[Dict]:
+        try:
+            size = os.stat(self.path).st_size
+        except OSError:
+            return []  # not written yet (or just rotated away)
+        if size < self._pos:
+            # atomic-replace rotation / truncation: start over
+            self._pos = 0
+            self._carry = b""
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(self._pos)
+                chunk = f.read()
+                self._pos = f.tell()
+        except OSError:
+            return []
+        data = self._carry + chunk
+        lines = data.split(b"\n")
+        # the final element has no newline yet: carry it — the writer
+        # may still be mid-append; it parses (or counts torn) when the
+        # terminating newline lands
+        self._carry = lines.pop()
+        out: List[Dict] = []
+        for raw in lines:
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                out.append(json.loads(raw.decode("utf-8",
+                                                 errors="replace")))
+            except json.JSONDecodeError:
+                self.torn += 1
+        return out
+
+    @property
+    def pending_partial(self) -> bool:
+        """A non-empty carry at end-of-run IS a torn tail (no writer
+        will ever finish it)."""
+        return bool(self._carry.strip())
+
+
+class WatchState:
+    """Accumulated view of one run dir's streams."""
+
+    def __init__(self, run_dir: str):
+        self.run_dir = run_dir
+        self.metrics_tail = JsonlTail(
+            os.path.join(run_dir, "metrics.jsonl"))
+        self.events_tail = JsonlTail(
+            os.path.join(run_dir, "events.jsonl"))
+        self.meta: Dict = {}
+        self.rows_by_round: Dict = {}
+        self.recent: List[Dict] = []  # arrival order, bounded
+        self.event_counts: Dict[str, int] = {}
+        self.restarts = 0
+        self._last_seq: Optional[int] = None
+        self._overlap = StreamOverlapTracker()
+        self.overlap_last: Optional[float] = None
+
+    @property
+    def torn(self) -> int:
+        return self.metrics_tail.torn + self.events_tail.torn
+
+    def poll(self) -> None:
+        for rec in self.metrics_tail.poll():
+            if "schema" in rec:
+                self.meta = rec.get("run", {}) or {}
+                continue
+            seq = rec.get("seq")
+            if isinstance(seq, int) and not isinstance(seq, bool):
+                # seq is strictly increasing per writer: a repeat is a
+                # restart boundary too (schema.count_restarts rule)
+                if self._last_seq is not None \
+                        and seq <= self._last_seq:
+                    self.restarts += 1
+                self._last_seq = seq
+            rnd = rec.get("round")
+            if isinstance(rnd, (int, float)) \
+                    and not isinstance(rnd, bool):
+                self.rows_by_round[rnd] = rec
+            self.recent.append(rec)
+            del self.recent[:-512]
+            # ALWAYS feed the tracker (its baseline must advance every
+            # row) but prefer the loop's own emitted gauge — same rule
+            # as critical_path.replay_overlap; feeding only gauge-less
+            # rows would leave a stale baseline and fabricate a
+            # multi-round efficiency at the next idle-producer round
+            derived = self._overlap.observe(rec)
+            eff = rec.get("overlap_efficiency")
+            if not isinstance(eff, (int, float)) \
+                    or isinstance(eff, bool):
+                eff = derived
+            if eff is not None:
+                self.overlap_last = float(eff)
+        for rec in self.events_tail.poll():
+            if "schema" in rec:
+                continue
+            name = rec.get("event", "?")
+            self.event_counts[name] = self.event_counts.get(name, 0) + 1
+
+    def rows(self) -> List[Dict]:
+        return [self.rows_by_round[k]
+                for k in sorted(self.rows_by_round)]
+
+    def rate_rounds_per_s(self) -> Optional[float]:
+        """Steady round rate over the most recent window: wall-clock
+        ``t`` stamps when the window is restart-free (they include the
+        dispatch gaps the per-round walls miss), falling back to the
+        ``round_s`` walls when a restart boundary sits inside the
+        window — a t-span across the boundary would count the outage
+        downtime as round time and deflate the rate."""
+        window = self.recent[-21:]
+        seqs = [r["seq"] for r in window
+                if isinstance(r.get("seq"), int)
+                and not isinstance(r.get("seq"), bool)]
+        straddles_restart = any(b <= a for a, b in zip(seqs, seqs[1:]))
+        if len(window) >= 2 and not straddles_restart:
+            ts = [r["t"] for r in window
+                  if isinstance(r.get("t"), (int, float))]
+            if len(ts) >= 2 and ts[-1] > ts[0]:
+                return (len(ts) - 1) / (ts[-1] - ts[0])
+        walls = [float(r.get("round_s", 0.0)) for r in window]
+        total = sum(walls)
+        return len(walls) / total if walls and total > 0 else None
+
+
+def sparkline(values: List[float], width: int = 24) -> str:
+    vals = [float(v) for v in values][-width:]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    return "".join(
+        SPARK_CHARS[min(int((v - lo) / span * (len(SPARK_CHARS) - 1)),
+                        len(SPARK_CHARS) - 1)] for v in vals)
+
+
+def _fmt_eta(seconds: Optional[float]) -> str:
+    if seconds is None or seconds < 0:
+        return "-"
+    s = int(seconds)
+    if s >= 3600:
+        return f"{s // 3600}h{(s % 3600) // 60:02d}m"
+    return f"{s // 60}m{s % 60:02d}s"
+
+
+def render_watch(state: WatchState, health: Optional[Dict],
+                 now: Optional[float] = None) -> str:
+    """The snapshot text (also the non-tty one-shot output) — the
+    output contract docs/observability.md documents; tests pin the
+    labelled fields, not the layout."""
+    now = time.time() if now is None else now
+    rows = state.rows()
+    lines = [f"watch: {state.run_dir}"]
+    meta = state.meta
+    if meta:
+        kv = " ".join(f"{k}={v}" for k, v in sorted(meta.items())
+                      if v is not None)
+        lines.append(f"config: {kv}")
+    intent = (health or {}).get("intent", "unknown")
+    hline = f"intent={intent}"
+    if health:
+        hline += (f" round={health.get('round')} "
+                  f"pid={health.get('pid')}")
+        since = health.get("since_progress_s")
+        if since is not None:
+            hline += f" since_progress={since:.1f}s"
+        age = now - health.get("updated_unix", now)
+        hline += f" health_age={max(age, 0.0):.1f}s"
+    lines.append(f"health: {hline}")
+    rate = state.rate_rounds_per_s()
+    done = len(rows)
+    total = meta.get("num_comms")
+    prog = f"rounds: {done}"
+    if isinstance(total, (int, float)) and total:
+        prog += f"/{int(total)}"
+    if rate:
+        prog += f"  rate={rate:.2f} rounds/s"
+        if isinstance(total, (int, float)) and total and rows:
+            remaining = max(int(total) - 1 - rows[-1]["round"], 0)
+            prog += f"  eta={_fmt_eta(remaining / rate)}"
+    lines.append(prog)
+    if rows:
+        last = rows[-1]
+        losses = [r["loss"] for r in rows if "loss" in r]
+        accs = [r["acc"] for r in rows if "acc" in r]
+        lines.append(f"loss: {last.get('loss', float('nan')):.4f} "
+                     f"{sparkline(losses)}")
+        line = (f"acc:  {last.get('acc', float('nan')):.4f} "
+                f"{sparkline(accs)}")
+        evals = [r for r in rows if "test_top1" in r]
+        if evals:
+            line += (f"   test_top1={evals[-1]['test_top1']:.4f} "
+                     f"(best {evals[-1].get('best_top1', 0.0):.4f})")
+        lines.append(line)
+        gauges = []
+        if state.overlap_last is not None:
+            gauges.append(f"overlap_eff={state.overlap_last:.2f}")
+        for key, label in (("stream_depth", "depth"),
+                           ("model_flops_utilization", "mfu"),
+                           ("round_host_frac", "host_frac"),
+                           ("staleness", "staleness")):
+            if key in last:
+                v = last[key]
+                gauges.append(f"{label}={v:.3g}")
+        if gauges:
+            lines.append("gauges: " + "  ".join(gauges))
+        counters = []
+        for key in ("host_retries", "host_degraded", "sup_rollbacks",
+                    "ckpt_lost_writes"):
+            if last.get(key):
+                counters.append(f"{key}={last[key]:g}")
+        anom = state.event_counts.get("anomaly.detected", 0)
+        counters.append(f"anomalies={anom}")
+        counters.append(f"torn={state.torn}")
+        counters.append(f"restarts={state.restarts}")
+        lines.append("counters: " + "  ".join(counters))
+    else:
+        lines.append(f"no metrics rows yet  torn={state.torn}")
+    interesting = {n: c for n, c in sorted(state.event_counts.items())
+                   if n not in ("run.start",)}
+    if interesting:
+        lines.append("events: " + "  ".join(
+            f"{n}={c}" for n, c in interesting.items()))
+    return "\n".join(lines)
+
+
+def _stdin_quit(timeout_s: float) -> bool:
+    """tty keybind: wait up to ``timeout_s`` for a 'q' keypress (raw,
+    no Enter needed where termios exists; line-buffered fallback
+    elsewhere). Never raises — a weird terminal degrades to sleep."""
+    try:
+        import select
+        import termios
+        import tty
+        fd = sys.stdin.fileno()
+        old = termios.tcgetattr(fd)
+        try:
+            tty.setcbreak(fd)
+            r, _w, _x = select.select([sys.stdin], [], [], timeout_s)
+            if r:
+                return sys.stdin.read(1).lower() == "q"
+            return False
+        finally:
+            termios.tcsetattr(fd, termios.TCSADRAIN, old)
+    except Exception:
+        time.sleep(timeout_s)
+        return False
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="fedtorch-tpu watch",
+        description="Live console over a run dir's telemetry "
+                    "(docs/observability.md 'Operating and comparing "
+                    "runs'); one-shot snapshot on non-tty")
+    p.add_argument("run_dir", help="the run dir to tail")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="poll/redraw interval, seconds (tty mode)")
+    p.add_argument("--once", action="store_true",
+                   help="print one snapshot and exit (the non-tty "
+                        "default, forced)")
+    p.add_argument("--max-ticks", type=int, default=0,
+                   help="exit after N redraws even if the run is "
+                        "still going (0 = until terminal intent/q)")
+    args = p.parse_args(argv)
+    if not os.path.isdir(args.run_dir):
+        print(f"watch: {args.run_dir}: not a directory",
+              file=sys.stderr)
+        return 2
+    state = WatchState(args.run_dir)
+    live = sys.stdout.isatty() and not args.once
+    ticks = 0
+    while True:
+        state.poll()
+        # health.json is atomically replaced, never appended: re-read
+        # whole each tick (read_health returns None mid-rotation race
+        # only if the file is absent — os.replace keeps it continuous)
+        try:
+            health = read_health(args.run_dir)
+        except ValueError as e:
+            print(f"watch: health.json: {e}", file=sys.stderr)
+            return 2
+        text = render_watch(state, health)
+        if live:
+            sys.stdout.write("\x1b[H\x1b[2J" + text
+                             + "\n[q to quit]\n")
+            sys.stdout.flush()
+        else:
+            print(text)
+        ticks += 1
+        intent = (health or {}).get("intent")
+        if not live or intent in TERMINAL_INTENTS \
+                or (args.max_ticks and ticks >= args.max_ticks):
+            if live and intent in TERMINAL_INTENTS:
+                print(f"watch: run reached terminal intent "
+                      f"{intent!r}")
+            return 0
+        try:
+            if _stdin_quit(args.interval):
+                return 0
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -53,6 +53,16 @@
 #                         north-star config, <=1% acceptance) +
 #                         artifacts/telemetry_northstar/ metrics.jsonl
 #                         + Perfetto trace.json capture
+#   compare          fedtorch-tpu compare of the fresh
+#                        artifacts/telemetry_northstar capture against
+#                        the previous armed capture's rotated copy
+#                        (artifacts/telemetry_northstar_prev), gated
+#                        by tests/data/ops_runs/gates.json
+#                        -> TELEMETRY_COMPARE.json; nonzero exit on a
+#                         gated regression (docs/observability.md
+#                         "Operating and comparing runs"). Always
+#                         rotates the fresh capture into _prev for the
+#                         next window; first window is baseline-only.
 #   conv-ab          BENCH_CONV_IMPL=matmul|conv  (lowering A/B, both)
 #   zoo              scripts/tpu_zoo_check.py     -> TPU_ZOO.json
 #   pallas           scripts/pallas_tpu_check.py  -> PALLAS_TPU.json
@@ -104,8 +114,8 @@ TRIES="${TPU_CAPTURE_WAIT_TRIES:-90}"   # ~6 h of patience by default
 # audit rides early: it is seconds of abstract lowering and proves the
 # program invariants on the real backend before the long benches run
 DEFAULT_STEPS="audit mfu stream builder-matrix async attack host-chaos \
-cohort telemetry bench-streaming bench-dispatch bench-unroll bench zoo \
-pallas flash-train vmap baseline"
+cohort telemetry compare bench-streaming bench-dispatch bench-unroll \
+bench zoo pallas flash-train vmap baseline"
 STEPS="${*:-$DEFAULT_STEPS}"
 
 echo "[tpu_capture] waiting for the relay (up to ${TRIES}x120s probes)"
@@ -138,6 +148,40 @@ for step in $STEPS; do
                             --ledger-out COHORT_AB.json ;;
         telemetry)      run python scripts/telemetry_bench.py \
                             --capture-run artifacts/telemetry_northstar ;;
+        compare)        # regression-gate the fresh telemetry capture
+                        # against the previous window's (rotated) one;
+                        # stdlib-only, no relay round trip. Freshness
+                        # guard: _prev is rotated (cp -r, mtimes reset
+                        # to rotation time) AFTER each capture, so a
+                        # capture that is not newer than _prev means
+                        # the telemetry step did NOT run this window —
+                        # comparing would diff stale data against its
+                        # own copy and report a bogus green. Skip the
+                        # compare AND the rotation in that case.
+                        if [ -d artifacts/telemetry_northstar_prev ] \
+                            && [ ! artifacts/telemetry_northstar/metrics.jsonl \
+                                 -nt artifacts/telemetry_northstar_prev/metrics.jsonl ]; then
+                            echo "[tpu_capture] compare: capture is not" \
+                                "newer than _prev (telemetry step" \
+                                "skipped/failed this window?) — skipping"
+                            FAILED=1
+                        else
+                            if [ -d artifacts/telemetry_northstar_prev ]; then
+                                run python -m fedtorch_tpu.tools.compare \
+                                    artifacts/telemetry_northstar_prev \
+                                    artifacts/telemetry_northstar \
+                                    --gate tests/data/ops_runs/gates.json \
+                                    --out TELEMETRY_COMPARE.json
+                            else
+                                echo "[tpu_capture] compare: no previous" \
+                                    "capture — recording baseline only"
+                            fi
+                            if [ -d artifacts/telemetry_northstar ]; then
+                                rm -rf artifacts/telemetry_northstar_prev
+                                cp -r artifacts/telemetry_northstar \
+                                    artifacts/telemetry_northstar_prev
+                            fi
+                        fi ;;
         conv-ab)        run env BENCH_CONV_IMPL=matmul python bench.py
                         run env BENCH_CONV_IMPL=conv python bench.py ;;
         zoo)            run python scripts/tpu_zoo_check.py ;;

@@ -1,0 +1,691 @@
+"""Operations plane (ISSUE 15, docs/observability.md "Operating and
+comparing runs"): run registry, regression-gated compare, live watch,
+and round-wall critical-path attribution.
+
+The contracts made executable here:
+
+* ``watch``/``compare``/``runs`` NEVER import jax (subprocess-pinned,
+  like the ``report`` rule they inherit);
+* every JSONL reader is torn-tail tolerant with a COUNTED warning, and
+  elastic-restart-appended files stitch unambiguously via the
+  per-writer ``seq`` stamp (last write per round wins);
+* ``overlap_efficiency`` math: hidden producer wall over producer
+  wall, clamped, ``None`` for an idle producer or a reset counter;
+* ``compare --gate`` exits 1 on the seeded synthetic regression
+  fixture, 0 on self-compare, 2 on unusable input — exact codes;
+* the end-to-end slow-lane smoke: two real CLI runs through the gate,
+  and a stream-plane run emits ``overlap_efficiency`` on its rows.
+"""
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from fedtorch_tpu.telemetry.critical_path import (
+    StreamOverlapTracker, device_floor_s, overlap_efficiency,
+    overlap_summary, replay_overlap, round_wall_decomposition,
+)
+from fedtorch_tpu.telemetry.schema import (
+    METRICS_OPTIONAL, count_restarts, load_jsonl, stitch_rows,
+    validate_metrics_row,
+)
+
+FIXROOT = os.path.join(os.path.dirname(__file__), "data", "ops_runs")
+CLEAN = os.path.join(FIXROOT, "clean")
+TORN = os.path.join(FIXROOT, "torn")
+RESTART = os.path.join(FIXROOT, "restart")
+REGRESSED = os.path.join(FIXROOT, "regressed")
+GATES = os.path.join(FIXROOT, "gates.json")
+
+
+# -- overlap_efficiency math --------------------------------------------
+
+
+class TestOverlapEfficiency:
+    def test_fully_hidden(self):
+        assert overlap_efficiency(1.0, 0.5, 0.0) == 1.0
+
+    def test_nothing_hidden(self):
+        # consumer waited the whole producer wall (and then some —
+        # extra wait clamps at 0, nothing provably hid)
+        assert overlap_efficiency(1.0, 0.0, 1.0) == 0.0
+        assert overlap_efficiency(1.0, 0.0, 5.0) == 0.0
+
+    def test_partial(self):
+        assert overlap_efficiency(1.0, 1.0, 0.5) == pytest.approx(0.75)
+
+    def test_idle_producer_is_none_not_perfect(self):
+        assert overlap_efficiency(0.0, 0.0, 0.0) is None
+        assert overlap_efficiency(0.0, 0.0, 1.0) is None
+
+    def test_negative_wait_clamped(self):
+        assert overlap_efficiency(1.0, 0.0, -3.0) == 1.0
+
+    def test_tracker_deltas(self):
+        t = StreamOverlapTracker()
+        assert t.observe({"stream_gather_s": 1.0, "stream_h2d_s": 0.5,
+                          "stream_wait_s": 0.1}) is None  # first row
+        eff = t.observe({"stream_gather_s": 2.0, "stream_h2d_s": 1.0,
+                         "stream_wait_s": 0.4})
+        # deltas: gather 1.0, h2d 0.5, wait 0.3 -> 1 - 0.3/1.5
+        assert eff == pytest.approx(0.8)
+
+    def test_tracker_counter_reset_yields_none(self):
+        t = StreamOverlapTracker()
+        t.observe({"stream_gather_s": 5.0, "stream_h2d_s": 1.0,
+                   "stream_wait_s": 1.0})
+        # producer rebuilt: cumulative counters re-zeroed
+        assert t.observe({"stream_gather_s": 0.5, "stream_h2d_s": 0.1,
+                          "stream_wait_s": 0.0}) is None
+        # and the NEXT delta is attributable again
+        assert t.observe({"stream_gather_s": 1.5, "stream_h2d_s": 0.1,
+                          "stream_wait_s": 0.0}) == 1.0
+
+    def test_tracker_ignores_non_stream_rows(self):
+        t = StreamOverlapTracker()
+        assert t.observe({"round": 0, "loss": 1.0}) is None
+
+    def test_replay_prefers_emitted_gauge(self):
+        rows = [
+            {"stream_gather_s": 1.0, "stream_h2d_s": 0.0,
+             "stream_wait_s": 0.0},
+            {"stream_gather_s": 2.0, "stream_h2d_s": 0.0,
+             "stream_wait_s": 0.5, "overlap_efficiency": 0.123},
+        ]
+        assert replay_overlap(rows) == [None, 0.123]
+
+    def test_counter_total_is_reset_aware(self):
+        from fedtorch_tpu.telemetry.critical_path import _counter_total
+        rows = [{"c": 1.0}, {"c": 3.0}, {"c": 0.5}, {"c": 2.5}]
+        # segment 1 grew to 3.0, the restarted segment grew to 2.5
+        assert _counter_total(rows, "c") == pytest.approx(5.5)
+        assert _counter_total(rows, "missing") == 0.0
+
+    def test_overlap_summary_spans_restart_reset(self):
+        def row(g, h, w):
+            return {"stream_gather_s": g, "stream_h2d_s": h,
+                    "stream_wait_s": w}
+        rows = [row(1.0, 0.5, 0.1), row(2.0, 1.0, 0.2),
+                # elastic restart: counters re-zeroed
+                row(0.5, 0.25, 0.05), row(1.5, 0.75, 0.15)]
+        ov = overlap_summary(rows)
+        # producer wall = (2.0+1.0) + (1.5+0.75); wait = 0.2 + 0.15 —
+        # NOT the last row's cumulative values alone
+        assert ov["producer_wall_s"] == pytest.approx(5.25)
+        assert ov["consumer_wait_s"] == pytest.approx(0.35)
+
+    def test_decomposition_exposure_spans_restart_reset(self):
+        rows = [{"round": r, "round_s": 0.1, "stream_wait_s": w}
+                for r, w in enumerate([0.1, 0.2, 0.05, 0.15])]
+        dec = round_wall_decomposition(rows)
+        # growth: 0.1 (r1) + 0.05 (restart segment r2) + 0.1 (r3)
+        # over 3 intervals — the restart must not clamp it to ~0
+        assert dec["stream_exposed_s"] == pytest.approx(0.25 / 3)
+
+    def test_overlap_summary_on_fixture(self):
+        _meta, rows, _torn = _load_fixture_rows(CLEAN)
+        ov = overlap_summary(rows)
+        assert ov["rounds"] == 5
+        assert ov["mean"] == pytest.approx(0.9667, abs=1e-4)
+        assert 0.0 < ov["exposed_frac"] < 1.0
+
+
+def _load_fixture_rows(run_dir):
+    header, records, torn = load_jsonl(
+        os.path.join(run_dir, "metrics.jsonl"))
+    return (header or {}).get("run", {}), stitch_rows(records), torn
+
+
+# -- torn tails + restart stitching -------------------------------------
+
+
+class TestTornAndStitch:
+    def test_clean_has_no_torn_lines(self):
+        _m, rows, torn = _load_fixture_rows(CLEAN)
+        assert torn == 0 and len(rows) == 6
+
+    def test_torn_tail_counted_not_fatal(self):
+        _m, rows, torn = _load_fixture_rows(TORN)
+        assert torn == 1
+        assert len(rows) == 5  # the torn final row is lost, counted
+
+    def test_restart_stitches_and_counts(self):
+        header, records, torn = load_jsonl(
+            os.path.join(RESTART, "metrics.jsonl"))
+        assert torn == 1  # the crash's buried partial line
+        assert count_restarts(records) == 1  # seq dropped once
+        rows = stitch_rows(records)
+        assert [r["round"] for r in rows] == [0, 1, 2, 3, 4, 5]
+        # the re-run rounds superseded the pre-crash ones (last write
+        # wins): the restart leg wrote loss - 0.001
+        assert rows[2]["loss"] == pytest.approx(1.0 - 0.001)
+
+    def test_restart_after_single_row_counts(self):
+        # pre-crash writer flushed exactly one row (seq 0); restart's
+        # first row is seq 0 again — a repeat IS a boundary
+        assert count_restarts([{"seq": 0}, {"seq": 0},
+                               {"seq": 1}]) == 1
+        assert count_restarts([{"seq": 0}, {"seq": 1}]) == 0
+        assert count_restarts([{}, {"seq": 0}]) == 0
+
+    def test_every_fixture_row_validates(self):
+        for d in (CLEAN, RESTART, REGRESSED):
+            _m, rows, _t = _load_fixture_rows(d)
+            for row in rows:
+                validate_metrics_row(row)
+
+    def test_report_counts_torn_and_restarts(self):
+        from fedtorch_tpu.tools.report import render, summarize
+        s = summarize(RESTART)
+        assert s["torn_lines"] == 1 and s["restarts"] == 1
+        out = render(RESTART)
+        assert "1 torn JSONL line(s)" in out
+        assert "restart" in out
+
+
+# -- critical-path decomposition ----------------------------------------
+
+
+class TestDecomposition:
+    def test_device_floor_from_costs_doc(self):
+        with open(os.path.join(CLEAN, "program_costs.json")) as f:
+            doc = json.load(f)
+        # 4.9e11 FLOPs at 98 TF/chip x 1 chip = 5 ms
+        assert device_floor_s(doc) == pytest.approx(0.005)
+        assert device_floor_s(None) is None
+        assert device_floor_s({"programs": {}, "primary": "x"}) is None
+
+    def test_decomposition_on_fixture(self):
+        with open(os.path.join(CLEAN, "program_costs.json")) as f:
+            doc = json.load(f)
+        _m, rows, _t = _load_fixture_rows(CLEAN)
+        dec = round_wall_decomposition(rows, doc)
+        assert dec["rounds"] == 5  # compile round excluded
+        assert dec["round_s_mean"] == pytest.approx(0.1)
+        assert dec["device_floor_frac"] == pytest.approx(0.05)
+        assert dec["host_frac"] == pytest.approx(0.95)
+        assert dec["unattributed_s"] == pytest.approx(0.095)
+
+    def test_report_renders_critical_path(self):
+        from fedtorch_tpu.tools.report import render, summarize
+        s = summarize(CLEAN)
+        assert s["critical_path"]["host_frac"] == pytest.approx(0.95)
+        assert s["overlap"]["mean"] == pytest.approx(0.9667, abs=1e-4)
+        out = render(CLEAN)
+        assert "critical path" in out and "device floor" in out
+        assert "stream overlap" in out
+
+    def test_new_gauges_cataloged(self):
+        for field in ("overlap_efficiency", "round_device_min_s",
+                      "round_host_frac", "seq", "t"):
+            assert field in METRICS_OPTIONAL
+
+
+class TestAnomalyReplay:
+    def test_replay_tolerates_torn_tail(self):
+        from fedtorch_tpu.telemetry.anomaly import replay_anomalies
+        out = replay_anomalies(TORN, zscore=6.0)
+        assert out["torn_lines"] == 1 and out["rows"] == 5
+        assert isinstance(out["anomalies"], list)
+        assert out["summary"]["loss"]["observations"] == 5
+
+    def test_replay_flags_seeded_excursion(self, tmp_path):
+        from fedtorch_tpu.telemetry.anomaly import replay_anomalies
+        d = str(tmp_path / "run")
+        os.makedirs(d)
+        with open(os.path.join(d, "metrics.jsonl"), "w") as f:
+            f.write(json.dumps({"schema": "fedtorch_tpu.metrics/v1"})
+                    + "\n")
+            for r in range(14):
+                loss = 1.0 + 0.001 * (r % 3) if r < 13 else 50.0
+                f.write(json.dumps({"round": r, "loss": loss}) + "\n")
+        out = replay_anomalies(d, zscore=6.0, warmup=5)
+        assert any(a["field"] == "loss" and a["round"] == 13
+                   for a in out["anomalies"])
+
+
+# -- seq/t stamping ------------------------------------------------------
+
+
+class TestRowStamps:
+    def test_writer_stamps_seq_and_t(self, tmp_path):
+        from fedtorch_tpu.telemetry.metrics import JsonlWriter
+        from fedtorch_tpu.telemetry.schema import METRICS_SCHEMA
+        path = str(tmp_path / "metrics.jsonl")
+        w = JsonlWriter(path, METRICS_SCHEMA)
+        base = {"round": 0, "round_s": 0.1, "loss": 1.0, "acc": 0.5,
+                "lr": 0.1, "n_online": 2.0, "comm_bytes": 10.0}
+        for r in range(3):
+            w.write(dict(base, round=r))
+        w.close()
+        _h, rows, torn = load_jsonl(path)
+        assert torn == 0
+        assert [r["seq"] for r in rows] == [0, 1, 2]
+        for r in rows:
+            assert isinstance(r["t"], float)
+            validate_metrics_row(r)
+
+    def test_existing_t_not_overwritten(self, tmp_path):
+        from fedtorch_tpu.telemetry.metrics import JsonlWriter
+        from fedtorch_tpu.telemetry.schema import EVENTS_SCHEMA
+        path = str(tmp_path / "events.jsonl")
+        w = JsonlWriter(path, EVENTS_SCHEMA)
+        w.write({"t": 123.0, "event": "run.start"}, flush=True)
+        w.close()
+        _h, rows, _torn = load_jsonl(path)
+        assert rows[0]["t"] == 123.0 and rows[0]["seq"] == 0
+
+    def test_restart_writer_isolates_torn_tail(self, tmp_path):
+        """A restart writer appending to a file whose last line was
+        torn mid-append (no newline) must NOT merge its first row into
+        the torn bytes — both rows would be lost and the STALE
+        pre-crash row would win the stitch."""
+        from fedtorch_tpu.telemetry.metrics import JsonlWriter
+        from fedtorch_tpu.telemetry.schema import METRICS_SCHEMA
+        path = str(tmp_path / "metrics.jsonl")
+        w = JsonlWriter(path, METRICS_SCHEMA)
+        w.write({"round": 0}, flush=True)
+        w.write({"round": 1}, flush=True)
+        w.close()
+        # crash: tear the final line mid-append (strip its newline too)
+        data = open(path, "rb").read()
+        with open(path, "wb") as f:
+            f.write(data[:-10])
+        # elastic restart: a fresh writer appends re-run rounds
+        w2 = JsonlWriter(path, METRICS_SCHEMA)
+        w2.write({"round": 1}, flush=True)
+        w2.close()
+        header, records, torn = load_jsonl(path)
+        assert torn == 1  # the torn bytes alone, isolated
+        rows = stitch_rows(records)
+        assert [r["round"] for r in rows] == [0, 1]
+        # the restart's round-1 row won (seq restarted at 0)
+        assert rows[1]["seq"] == 0
+        assert count_restarts(records) == 1
+
+    def test_caller_row_not_mutated(self, tmp_path):
+        from fedtorch_tpu.telemetry.metrics import JsonlWriter
+        from fedtorch_tpu.telemetry.schema import METRICS_SCHEMA
+        w = JsonlWriter(str(tmp_path / "m.jsonl"), METRICS_SCHEMA)
+        row = {"round": 0}
+        w.write(row)
+        w.close()
+        assert row == {"round": 0}
+
+
+# -- compare + gates -----------------------------------------------------
+
+
+class TestCompareGates:
+    def test_self_compare_exits_zero(self, capsys):
+        from fedtorch_tpu.tools.compare import main
+        assert main([CLEAN, CLEAN, "--gate", GATES]) == 0
+        out = capsys.readouterr().out
+        assert "FAIL" not in out
+
+    def test_seeded_regression_exits_one(self, capsys):
+        from fedtorch_tpu.tools.compare import main
+        assert main([CLEAN, REGRESSED, "--gate", GATES]) == 1
+        out = capsys.readouterr().out
+        assert "GATE FAIL" in out
+        # the seeded regressions each trip their gate
+        assert "rounds_per_s_steady" in out
+        assert "final_acc" in out
+        assert "overlap_efficiency_mean" in out
+        assert "pc.peak_hbm_bytes" in out
+
+    def test_no_gate_is_informational_zero(self):
+        from fedtorch_tpu.tools.compare import main
+        assert main([CLEAN, REGRESSED]) == 0
+
+    def test_missing_run_dir_exits_two(self, tmp_path):
+        from fedtorch_tpu.tools.compare import main
+        assert main([str(tmp_path / "nope"), CLEAN]) == 2
+
+    def test_bad_gate_file_exits_two(self, tmp_path):
+        from fedtorch_tpu.tools.compare import main
+        bad = tmp_path / "bad_gates.json"
+        bad.write_text(json.dumps({
+            "schema": "fedtorch_tpu.compare_gates/v1",
+            "gates": {"final_acc": {"max_decreese_abs": 0.1}}}))
+        assert main([CLEAN, CLEAN, "--gate", str(bad)]) == 2
+
+    def test_gate_limits_must_be_numeric(self):
+        from fedtorch_tpu.tools.compare import GATES_SCHEMA, load_gates
+        import tempfile
+        with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                         delete=False) as f:
+            json.dump({"schema": GATES_SCHEMA,
+                       "gates": {"x": {"max_b": True}}}, f)
+        with pytest.raises(ValueError, match="must be a number"):
+            load_gates(f.name)
+
+    def test_required_gate_fails_on_missing_metric(self):
+        from fedtorch_tpu.tools.compare import (
+            compare_runs, evaluate_gates,
+        )
+        cmp_doc = compare_runs(CLEAN, CLEAN)
+        gates = {"gates": {
+            "gauge.no_such_gauge": {"min_b": 1.0, "required": True},
+            "gauge.also_missing": {"min_b": 1.0}}}
+        failures, checked, skipped = evaluate_gates(cmp_doc, gates)
+        assert [f["metric"] for f in failures] == ["gauge.no_such_gauge"]
+        assert skipped == ["gauge.also_missing"]
+
+    def test_compare_doc_contents(self):
+        from fedtorch_tpu.tools.compare import compare_runs
+        doc = compare_runs(CLEAN, REGRESSED)
+        m = doc["metrics"]
+        assert m["rounds_per_s_steady"]["frac"] == \
+            pytest.approx(-1 / 3, abs=1e-3)
+        assert m["pc.peak_hbm_bytes"]["delta"] == pytest.approx(1e8)
+        assert doc["trajectory"]["rounds_compared"] == 6
+        assert doc["trajectory"]["acc_max_abs_gap"] == \
+            pytest.approx(0.1)
+        assert doc["events"]["anomaly.detected"]["delta"] == 1
+
+    def test_unwritable_out_exits_two(self, tmp_path):
+        from fedtorch_tpu.tools.compare import main
+        assert main([CLEAN, CLEAN,
+                     "--out", str(tmp_path / "no" / "dir" / "o.json")
+                     ]) == 2
+
+    def test_unreadable_run_dir_exits_two(self, tmp_path, monkeypatch):
+        """PermissionError (and any other OSError) is 'unusable
+        input' (2), never a fake gated regression (1)."""
+        from fedtorch_tpu.tools import compare as cmp_mod
+
+        def boom(_dir):
+            raise PermissionError("metrics.jsonl: permission denied")
+        monkeypatch.setattr(cmp_mod, "_summary", boom)
+        assert cmp_mod.main([CLEAN, CLEAN]) == 2
+
+    def test_out_file_written(self, tmp_path):
+        from fedtorch_tpu.tools.compare import main
+        out = tmp_path / "cmp.json"
+        assert main([CLEAN, CLEAN, "--out", str(out)]) == 0
+        doc = json.loads(out.read_text())
+        assert doc["schema"] == "fedtorch_tpu.run_compare/v1"
+
+    def test_cli_routing(self, capsys):
+        from fedtorch_tpu.cli import main
+        assert main(["compare", CLEAN, CLEAN]) == 0
+        assert "compare:" in capsys.readouterr().out
+
+
+# -- the runs registry ---------------------------------------------------
+
+
+class TestRunsRegistry:
+    def test_index_document(self, tmp_path):
+        root = str(tmp_path / "root")
+        shutil.copytree(FIXROOT, root)
+        from fedtorch_tpu.telemetry.runs import build_index, load_index
+        doc = build_index(root)
+        assert doc["schema"] == "fedtorch_tpu.runs_index/v1"
+        names = {r["name"] for r in doc["runs"]}
+        assert names == {"clean", "torn", "restart", "regressed"}
+        by = {r["name"]: r for r in doc["runs"]}
+        assert by["clean"]["health"]["intent"] == "complete"
+        assert by["torn"]["torn_lines"] == 1
+        assert by["restart"]["restarts"] == 1
+        assert by["regressed"]["anomalies"] == 1
+        assert by["clean"]["overlap_efficiency_mean"] == \
+            pytest.approx(0.9667, abs=1e-4)
+        assert by["clean"]["program_costs"]["primary"] == "round_stream"
+        # written atomically and loadable
+        assert load_index(root)["runs"]
+
+    def test_broken_dir_becomes_error_record(self, tmp_path):
+        root = tmp_path / "root"
+        run = root / "broken"
+        run.mkdir(parents=True)
+        (run / "metrics.jsonl").write_text("")  # empty: no header, no rows
+        (run / "health.json").write_text("{not json")
+        from fedtorch_tpu.telemetry.runs import build_index
+        doc = build_index(str(root), write=False)
+        # unreadable health degrades to None, empty metrics to 0 rounds
+        # — neither kills the index
+        assert len(doc["runs"]) == 1
+        rec = doc["runs"][0]
+        assert rec["name"] == "broken" and rec.get("rounds", 0) == 0
+
+    def test_filters(self):
+        from fedtorch_tpu.telemetry.runs import match_filters
+        rec = {"meta": {"algorithm": "fedavg"}, "rounds": 6,
+               "health": {"intent": "complete"}}
+        assert match_filters(rec, ["meta.algorithm=fed"])
+        assert match_filters(rec, ["rounds=6",
+                                   "health.intent=complete"])
+        assert not match_filters(rec, ["rounds=7"])
+        assert not match_filters(rec, ["meta.no_such_key=x"])
+
+    def test_cli_routing_and_filter(self, tmp_path, capsys):
+        root = str(tmp_path / "root")
+        shutil.copytree(FIXROOT, root)
+        from fedtorch_tpu.cli import main
+        assert main(["runs", root, "--filter",
+                     "health.intent=error", "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert [r["name"] for r in doc["runs"]] == ["torn"]
+
+    def test_not_a_directory_exits_two(self, tmp_path):
+        from fedtorch_tpu.telemetry.runs import main
+        assert main([str(tmp_path / "nope")]) == 2
+
+
+# -- watch ---------------------------------------------------------------
+
+
+class TestWatch:
+    def _copy(self, src, tmp_path):
+        dst = str(tmp_path / os.path.basename(src))
+        shutil.copytree(src, dst)
+        return dst
+
+    def test_tail_incremental_with_partial_line(self, tmp_path):
+        from fedtorch_tpu.tools.watch import JsonlTail
+        path = str(tmp_path / "m.jsonl")
+        tail = JsonlTail(path)
+        assert tail.poll() == []  # not written yet
+        with open(path, "w") as f:
+            f.write('{"round": 0}\n{"round": 1, "lo')
+            f.flush()
+        recs = tail.poll()
+        assert [r["round"] for r in recs] == [0]
+        assert tail.pending_partial and tail.torn == 0
+        # the writer finishes the line: it parses on the next poll
+        with open(path, "a") as f:
+            f.write('ss": 1.0}\n')
+        recs = tail.poll()
+        assert recs == [{"round": 1, "loss": 1.0}]
+        assert not tail.pending_partial
+
+    def test_tail_counts_durably_torn_line(self, tmp_path):
+        from fedtorch_tpu.tools.watch import JsonlTail
+        path = str(tmp_path / "m.jsonl")
+        with open(path, "w") as f:
+            f.write('{"round": 0}\n{"torn\n{"round": 1}\n')
+        tail = JsonlTail(path)
+        recs = tail.poll()
+        assert [r["round"] for r in recs] == [0, 1]
+        assert tail.torn == 1
+
+    def test_tail_survives_truncation(self, tmp_path):
+        from fedtorch_tpu.tools.watch import JsonlTail
+        path = str(tmp_path / "m.jsonl")
+        with open(path, "w") as f:
+            f.write('{"round": 0}\n{"round": 1}\n')
+        tail = JsonlTail(path)
+        assert len(tail.poll()) == 2
+        # atomic-replace style rotation: smaller file, fresh content
+        with open(path, "w") as f:
+            f.write('{"round": 9}\n')
+        assert tail.poll() == [{"round": 9}]
+
+    def test_state_counts_restarts_and_renders(self, tmp_path):
+        from fedtorch_tpu.tools.watch import WatchState, render_watch
+        from fedtorch_tpu.telemetry.health import read_health
+        d = self._copy(RESTART, tmp_path)
+        state = WatchState(d)
+        state.poll()
+        assert state.restarts == 1 and state.torn == 1
+        assert [r["round"] for r in state.rows()] == [0, 1, 2, 3, 4, 5]
+        out = render_watch(state, read_health(d), now=1754300200.0)
+        assert "intent=complete" in out
+        assert "rounds: 6/6" in out
+        assert "overlap_eff=0.97" in out
+        assert "torn=1" in out and "restarts=1" in out
+        assert "loss:" in out and "acc:" in out
+
+    def test_rate_falls_back_to_walls_across_restart(self, tmp_path):
+        """A window straddling a restart boundary must not divide by
+        the wall-clock span (it contains the outage downtime) — the
+        rate falls back to the round_s walls."""
+        from fedtorch_tpu.tools.watch import WatchState
+        d = str(tmp_path / "live")
+        os.makedirs(d)
+        with open(os.path.join(d, "metrics.jsonl"), "w") as f:
+            for i, (seq, t) in enumerate(
+                    [(0, 100.0), (1, 100.1),
+                     (0, 700.0), (1, 700.1)]):  # 10-min outage gap
+                f.write(json.dumps({"round": i if seq else i,
+                                    "seq": seq, "t": t,
+                                    "round_s": 0.1}) + "\n")
+        state = WatchState(d)
+        state.poll()
+        # walls: 4 rounds x 0.1 s -> 10 rounds/s, NOT 3/600.2
+        assert state.rate_rounds_per_s() == pytest.approx(10.0)
+
+    def test_tracker_baseline_advances_under_emitted_gauges(
+            self, tmp_path):
+        """The state must feed its tracker EVERY row (preferring the
+        emitted gauge): an idle-producer round after a string of
+        gauge-carrying rows must not fabricate a multi-round
+        efficiency from a stale baseline."""
+        from fedtorch_tpu.tools.watch import WatchState
+        d = str(tmp_path / "live")
+        os.makedirs(d)
+        mpath = os.path.join(d, "metrics.jsonl")
+        rows = [
+            {"round": 0, "stream_gather_s": 1.0, "stream_h2d_s": 0.0,
+             "stream_wait_s": 0.0},
+            {"round": 1, "stream_gather_s": 2.0, "stream_h2d_s": 0.0,
+             "stream_wait_s": 1.0, "overlap_efficiency": 0.9},
+            # idle producer round: counters unchanged, no gauge —
+            # derived efficiency is None, display keeps the last one
+            {"round": 2, "stream_gather_s": 2.0, "stream_h2d_s": 0.0,
+             "stream_wait_s": 1.0},
+        ]
+        with open(mpath, "w") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+        state = WatchState(d)
+        state.poll()
+        assert state.overlap_last == pytest.approx(0.9)
+
+    def test_snapshot_mode_exit_codes(self, tmp_path, capsys):
+        from fedtorch_tpu.cli import main
+        d = self._copy(CLEAN, tmp_path)
+        assert main(["watch", d, "--once"]) == 0
+        out = capsys.readouterr().out
+        assert "watch:" in out and "rate=" in out
+        assert main(["watch", str(tmp_path / "nope")]) == 2
+
+    def test_live_loop_incremental(self, tmp_path):
+        """Simulated live run: rows appended between polls, health
+        atomically replaced — the state follows without re-reading
+        from scratch (offsets advance monotonically)."""
+        from fedtorch_tpu.tools.watch import WatchState
+        d = str(tmp_path / "live")
+        os.makedirs(d)
+        mpath = os.path.join(d, "metrics.jsonl")
+        with open(mpath, "w") as f:
+            f.write(json.dumps({"schema": "fedtorch_tpu.metrics/v1",
+                                "run": {"num_comms": 4}}) + "\n")
+        state = WatchState(d)
+        state.poll()
+        assert state.meta["num_comms"] == 4 and not state.rows()
+        for r in range(4):
+            with open(mpath, "a") as f:
+                f.write(json.dumps({"round": r, "round_s": 0.1,
+                                    "loss": 1.0, "acc": 0.5,
+                                    "lr": 0.1, "n_online": 2.0,
+                                    "comm_bytes": 1.0, "seq": r,
+                                    "t": 100.0 + r}) + "\n")
+            state.poll()
+            assert len(state.rows()) == r + 1
+
+
+# -- the no-jax rule -----------------------------------------------------
+
+
+class TestNoJaxImport:
+    def test_ops_tools_never_import_jax(self):
+        """watch/compare/runs (and the report they build on) parse a
+        run dir end-to-end in a subprocess without jax ever landing
+        in sys.modules — the monitor-box rule."""
+        code = (
+            "import sys\n"
+            "from fedtorch_tpu.tools.compare import main as cmain\n"
+            "from fedtorch_tpu.tools.watch import main as wmain\n"
+            "from fedtorch_tpu.telemetry.runs import main as rmain\n"
+            f"assert cmain([{CLEAN!r}, {REGRESSED!r}]) == 0\n"
+            f"assert wmain([{CLEAN!r}, '--once']) == 0\n"
+            f"assert rmain([{FIXROOT!r}, '--no-write']) == 0\n"
+            "assert 'jax' not in sys.modules, 'jax was imported'\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr
+
+
+# -- end-to-end slow-lane smoke ------------------------------------------
+
+
+def _mini_cfg(run_dir, plane="stream", seed=6):
+    from fedtorch_tpu.config import (
+        CheckpointConfig, DataConfig, ExperimentConfig,
+        FederatedConfig, ModelConfig, OptimConfig, TrainConfig,
+    )
+    return ExperimentConfig(
+        data=DataConfig(dataset="synthetic", synthetic_dim=10,
+                        batch_size=8, data_plane=plane),
+        federated=FederatedConfig(
+            federated=True, num_clients=8, num_comms=4,
+            online_client_rate=0.5, algorithm="fedavg",
+            sync_type="local_step"),
+        model=ModelConfig(arch="logistic_regression"),
+        optim=OptimConfig(lr=0.1, weight_decay=0.0),
+        train=TrainConfig(local_step=2, manual_seed=seed, eval_freq=4),
+        checkpoint=CheckpointConfig(run_dir=run_dir, debug=False),
+    ).finalize()
+
+
+class TestEndToEndGateSmoke:
+    def test_stream_run_emits_overlap_and_self_compare_gates(
+            self, tmp_path):
+        """The gate smoke (slow lane): a real stream-plane CLI run
+        emits per-round overlap_efficiency, indexes into the
+        registry, and self-compares clean through the gate file."""
+        from fedtorch_tpu.cli import main, run_experiment
+        run_dir = str(tmp_path / "runs_root" / "stream_run")
+        run_experiment(_mini_cfg(run_dir))
+        _m, rows, torn = _load_fixture_rows(run_dir)
+        assert torn == 0 and len(rows) == 4
+        # acceptance: overlap_efficiency is emitted on stream-plane
+        # runs (round 0 has no prior producer baseline)
+        assert any("overlap_efficiency" in r for r in rows[1:])
+        for r in rows:
+            validate_metrics_row(r)
+            assert r["seq"] == r["round"]
+        assert main(["runs", str(tmp_path / "runs_root"),
+                     "--no-write"]) == 0
+        assert main(["compare", run_dir, run_dir,
+                     "--gate", GATES]) == 0
