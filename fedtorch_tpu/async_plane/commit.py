@@ -70,6 +70,7 @@ from fedtorch_tpu.parallel.mesh import replicate
 from fedtorch_tpu.parallel.round_program import (
     ASYNC_ALGORITHMS, ASYNC_TRAIN_SALT, CommitJobs,
 )
+from fedtorch_tpu.robustness.availability import make_availability_model
 from fedtorch_tpu.utils.tracing import instrument_trace
 
 __all__ = ["ASYNC_ALGORITHMS", "AsyncFederatedTrainer", "CommitJobs"]
@@ -209,7 +210,12 @@ class AsyncFederatedTrainer(FederatedTrainer):
             num_clients=self.num_clients, concurrency=self.concurrency,
             buffer_size=self.buffer_size, ring_size=self.snapshot_ring,
             straggler_rate=flt.straggler_rate,
-            straggler_step_frac=flt.straggler_step_frac)
+            straggler_step_frac=flt.straggler_step_frac,
+            # the arrival model (robustness/availability.py): the
+            # default reproduces the legacy draws bitwise, 'trace'
+            # arms device classes + diurnal dropout. Built fresh per
+            # schedule so a rebuilt scheduler replays identically.
+            model=make_availability_model(flt))
 
     def _server_key_state(self, server):
         """One batched fetch of (raw key data, commit) — paid only at
@@ -350,6 +356,7 @@ class AsyncFederatedTrainer(FederatedTrainer):
             "async_dispatches": float(st.dispatches),
             "async_stragglers": float(st.stragglers),
             "async_ring_clamped": float(st.staleness_clamped),
+            "async_dropouts": float(st.dropouts),
             "async_buffer": float(self.buffer_size),
             "async_commit_rate": (len(ct) / ct[-1])
             if ct and ct[-1] > 0 else 0.0,

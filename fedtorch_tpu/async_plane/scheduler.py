@@ -3,12 +3,19 @@
 The asynchronous server is simulated as an in-program discrete-event
 system: ``concurrency`` clients are always training ("in flight"), each
 against the snapshot version current at its dispatch; per-dispatch
-completion delays are threefry draws off the experiment key (the chaos
-subsystem's straggler knobs reinterpreted as wall-clock long tails —
-``fault.straggler_rate`` is the probability a dispatch lands in the
-tail, ``1/fault.straggler_step_frac`` its slowdown), so **client
-completion order is a pure function of (seed, commit)** — the async
-plane stays testable, resumable, and trace-once like every other plane.
+completion delays, straggler flags and mid-round dropouts come from a
+pluggable :class:`~fedtorch_tpu.robustness.availability
+.AvailabilityModel` — all threefry draws off the experiment key, so
+**client completion order is a pure function of (seed, commit)** — the
+async plane stays testable, resumable, and trace-once like every other
+plane. The default model reproduces the historical draws bitwise: the
+chaos subsystem's straggler knobs reinterpreted as wall-clock long
+tails (``fault.straggler_rate`` the probability a dispatch lands in
+the tail, ``1/fault.straggler_step_frac`` its slowdown). That aliasing
+is DEPRECATED spelling (config.finalize warns): ``fault
+.avail_model='trace'`` selects the synthetic deployment trace —
+device-class speed multipliers + diurnal dropout
+(docs/robustness.md "Deployment realism").
 
 One :meth:`AsyncSchedule.next_commit` pops the next ``buffer_size``
 arrivals, immediately re-dispatching each arrived client's replacement
@@ -39,13 +46,18 @@ from fedtorch_tpu.data.streaming import _cpu_device, _cpu_scope
 # family whose PRNG contract it is (parallel/round_program.py);
 # re-exported here for the host-replay twins that import it
 from fedtorch_tpu.parallel.round_program import ASYNC_TRAIN_SALT  # noqa: F401
+from fedtorch_tpu.robustness.availability import (
+    LEGACY_DELAY_SALT, AvailabilityModel, DefaultAvailability,
+)
 
 # fold constants separating the scheduler's PRNG streams from the
 # round streams (chaos_salt 0x7FFFFFFD, the augmentation parent
 # 0x7FFFFFFF and ASYNC_TRAIN_SALT 0x7FFFFFF9 are taken; all are
-# < 2^31 so fold_in accepts them)
-_DELAY_SALT = 0x7FFFFFF7        # per-dispatch completion delay
-_SELECT_SALT = 0x7FFFFFF5       # per-replacement client selection
+# < 2^31 so fold_in accepts them). The delay salt's source of truth
+# moved to robustness/availability.py with the model that owns the
+# legacy fold chain; re-exported for the A/B twins that import it.
+_DELAY_SALT = LEGACY_DELAY_SALT  # per-dispatch completion delay
+_SELECT_SALT = 0x7FFFFFF5        # per-replacement client selection
 
 
 class HostCommitPlan(NamedTuple):
@@ -67,6 +79,8 @@ class ScheduleStats(NamedTuple):
     dispatches: int
     stragglers: int
     staleness_clamped: int  # arrivals older than the snapshot ring
+    dropouts: int = 0       # mid-round dropouts (arrival discarded,
+                            # replacement dispatched)
 
 
 class AsyncSchedule:
@@ -79,7 +93,8 @@ class AsyncSchedule:
     def __init__(self, key_data, key_impl, *, num_clients: int,
                  concurrency: int, buffer_size: int, ring_size: int,
                  straggler_rate: float, straggler_step_frac: float,
-                 jitter: float = 0.25, start_commit: int = 0):
+                 jitter: float = 0.25, start_commit: int = 0,
+                 model: AvailabilityModel = None):
         if buffer_size < 1 or concurrency < 1:
             raise ValueError("buffer_size and concurrency must be >= 1")
         if num_clients < concurrency + buffer_size:
@@ -95,17 +110,19 @@ class AsyncSchedule:
         self._rate = float(straggler_rate)
         self._tail = 1.0 / float(straggler_step_frac)
         self._jitter = float(jitter)
+        # no model = the pre-availability scheduler, bitwise: the
+        # default model owns the exact legacy fold chain
+        self._model = model if model is not None else \
+            DefaultAvailability(straggler_rate=straggler_rate,
+                                straggler_step_frac=straggler_step_frac,
+                                jitter=jitter)
 
         self._cpu = _cpu_device()
         with self._scope():
             self._key = jax.random.wrap_key_data(
                 jnp.asarray(np.asarray(key_data)), impl=key_impl)
 
-            def delays(key, dispatch_ids):
-                rngs = jax.vmap(lambda d: jax.random.fold_in(
-                    jax.random.fold_in(key, _DELAY_SALT), d))(dispatch_ids)
-                return jax.vmap(
-                    lambda r: jax.random.uniform(r, (2,)))(rngs)
+            delays = self._model.traced
 
             def select(key, select_id):
                 r = jax.random.fold_in(
@@ -120,14 +137,15 @@ class AsyncSchedule:
             self._select_jit = jax.jit(select)
 
         # event state: min-heap of (finish_time, dispatch_id, client,
-        # version, straggler) — dispatch_id breaks (measure-zero) ties
-        # deterministically
-        self._heap: List[Tuple[float, int, int, int, bool]] = []
+        # version, straggler, dropped) — dispatch_id breaks
+        # (measure-zero) ties deterministically
+        self._heap: List[Tuple[float, int, int, int, bool, bool]] = []
         self._inflight: Set[int] = set()
         self._dispatch_count = 0
         self._select_count = 0
         self._commit = 0
         self._stragglers = 0
+        self._dropouts = 0
         self._clamped = 0
         self.commit_times: List[float] = []
         # staleness histogram: {commits-stale: count} over every
@@ -154,23 +172,29 @@ class AsyncSchedule:
             self._select_count += 1
             return np.asarray(jax.device_get(s))
 
-    def _draw_delays(self, dispatch_ids: np.ndarray):
+    def _draw_delays(self, dispatch_ids: np.ndarray,
+                     clients: np.ndarray, versions: np.ndarray):
+        """One jitted model draw per dispatch batch -> float64 host
+        math in the model's ``finish`` (the default model's split is
+        bitwise-identical to the historical inline computation)."""
+        versions = np.asarray(versions, np.int32)
         with self._scope():
             u = jax.device_get(self._delays_jit(
-                self._key, np.asarray(dispatch_ids, np.int32)))
-        u = np.asarray(u, np.float64)
-        base = 1.0 + self._jitter * u[:, 1]
-        straggler = u[:, 0] < self._rate
-        return np.where(straggler, base * self._tail, base), straggler
+                self._key, np.asarray(dispatch_ids, np.int32),
+                np.asarray(clients, np.int32), versions))
+        return self._model.finish(np.asarray(u, np.float64), versions)
 
     def _dispatch(self, client: int, version: int, now: float) -> None:
         did = self._dispatch_count
         self._dispatch_count += 1
-        delay, straggler = self._draw_delays(np.asarray([did]))
+        delay, straggler, dropped = self._draw_delays(
+            np.asarray([did]), np.asarray([client]),
+            np.asarray([version]))
         if straggler[0]:
             self._stragglers += 1
         heapq.heappush(self._heap, (now + float(delay[0]), did, client,
-                                    version, bool(straggler[0])))
+                                    version, bool(straggler[0]),
+                                    bool(dropped[0])))
         self._inflight.add(client)
 
     def _pick_replacement(self, exclude: Set[int]) -> int:
@@ -190,8 +214,20 @@ class AsyncSchedule:
         buffer: List[Tuple[float, int, int, int, bool]] = []
         buffered: Set[int] = set()
         while len(buffer) < m:
-            t, did, client, version, straggler = heapq.heappop(self._heap)
+            t, did, client, version, straggler, dropped = \
+                heapq.heappop(self._heap)
             self._inflight.discard(client)
+            if dropped:
+                # mid-round dropout: the arrival never reports — the
+                # update is discarded (it was never materialized; "in
+                # flight" is bookkeeping) and the slot re-fills. The
+                # dropped client is offline, so it is excluded from
+                # its own replacement draw.
+                self._dropouts += 1
+                repl = self._pick_replacement(
+                    self._inflight | buffered | {client})
+                self._dispatch(repl, version=self._commit, now=t)
+                continue
             buffer.append((t, did, client, version, straggler))
             buffered.add(client)
             repl = self._pick_replacement(self._inflight | buffered)
@@ -225,7 +261,8 @@ class AsyncSchedule:
     def stats(self) -> ScheduleStats:
         return ScheduleStats(dispatches=self._dispatch_count,
                              stragglers=self._stragglers,
-                             staleness_clamped=self._clamped)
+                             staleness_clamped=self._clamped,
+                             dropouts=self._dropouts)
 
 
 def simulate_sync_round_times(key_data, key_impl, *, rounds: int,

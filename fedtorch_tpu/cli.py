@@ -344,6 +344,40 @@ def build_parser() -> argparse.ArgumentParser:
                         "stacks to the run log and exit with the "
                         "restartable code 75 so the restart harness "
                         "cycles the job (docs/robustness.md)")
+    # deployment-realism availability plane + round lifecycle
+    # (robustness/availability.py; docs/robustness.md "Deployment
+    # realism")
+    p.add_argument("--avail_model", default="default",
+                   choices=("default", "trace"),
+                   help="client availability model driving async "
+                        "arrival delays and the sync round lifecycle: "
+                        "'default' reproduces the legacy straggler-"
+                        "knob draws bitwise; 'trace' adds FedScale-"
+                        "style device speed classes and diurnal on/off "
+                        "curves from an in-tree synthetic trace")
+    p.add_argument("--avail_dropout_rate", type=float, default=0.0,
+                   help="per-dispatch probability a client drops "
+                        "mid-round (async: arrival discarded and slot "
+                        "re-dispatched; sync: local state rolled back "
+                        "and update masked)")
+    p.add_argument("--avail_diurnal_period", type=int, default=0,
+                   help="trace model only: rounds per diurnal cycle "
+                        "(0 = flat availability)")
+    p.add_argument("--over_select_frac", type=float, default=1.0,
+                   help=">1 over-selects ceil(frac*k) clients per sync "
+                        "round and closes the round on the first k "
+                        "arrivals; late survivors are deadline-masked "
+                        "through the accept seam")
+    p.add_argument("--avail_quorum_frac", type=float, default=0.0,
+                   help=">0: a sync round whose accepted cohort falls "
+                        "below ceil(frac*k) is sub-quorum — see "
+                        "--avail_quorum_action")
+    p.add_argument("--avail_quorum_action", default="degrade",
+                   choices=("degrade", "abort"),
+                   help="sub-quorum handling: 'degrade' commits the "
+                        "renormalized partial cohort (counted + "
+                        "evented); 'abort' escalates to the supervisor "
+                        "retry/skip path (requires --supervisor)")
     # device / mesh (replaces parameters.py:225-236 MPI block)
     p.add_argument("--backend", default=None,
                    help="jax platform: tpu|cpu|None(auto)")
@@ -578,7 +612,13 @@ def args_to_config(args) -> ExperimentConfig:
             host_fault_max=args.host_fault_max,
             host_retry_max=args.host_retry_max,
             host_retry_backoff_s=args.host_retry_backoff_s,
-            watchdog_timeout_s=args.watchdog_timeout_s),
+            watchdog_timeout_s=args.watchdog_timeout_s,
+            avail_model=args.avail_model,
+            avail_dropout_rate=args.avail_dropout_rate,
+            avail_diurnal_period=args.avail_diurnal_period,
+            over_select_frac=args.over_select_frac,
+            avail_quorum_frac=args.avail_quorum_frac,
+            avail_quorum_action=args.avail_quorum_action),
         experiment=args.experiment,
     )
     return cfg.finalize()
@@ -854,6 +894,9 @@ def run_experiment(cfg: ExperimentConfig,
     loop_raised = False
     byz_attack_seen = False
     host_retries_seen = 0
+    # consecutive sub-quorum rounds (availability lifecycle): a
+    # persistent streak flips the health intent to 'degraded' below
+    quorum_streak = 0
     # round-wall critical path (telemetry/critical_path.py): per-round
     # overlap efficiency from the DELTAS of the producer's cumulative
     # gather/H2D/wait gauges — pure host float math over values the
@@ -1056,6 +1099,10 @@ def run_experiment(cfg: ExperimentConfig,
                 "byzantine": sc["byzantine"],
                 "robust_selected": sc["robust_selected"],
                 "robust_trimmed": sc["robust_trimmed"],
+                # deployment-realism lifecycle counters — same fetch
+                "avail_dropped": sc["avail_dropped"],
+                "deadline_missed": sc["deadline_missed"],
+                "quorum_degraded": sc["quorum_degraded"],
             }
             if eval_s is not None:
                 row["eval_s"] = eval_s
@@ -1099,7 +1146,14 @@ def run_experiment(cfg: ExperimentConfig,
                 row.update(sup_rollbacks=float(supervisor.stats.rollbacks),
                            sup_retries=float(supervisor.stats.retries),
                            sup_skipped=float(
-                               supervisor.stats.skipped_rounds))
+                               supervisor.stats.skipped_rounds),
+                           # skip-cause split (fault vs sub-quorum
+                           # abort) — docs/robustness.md "Deployment
+                           # realism"
+                           sup_skipped_fault=float(
+                               supervisor.stats.skipped_fault),
+                           sup_skipped_quorum=float(
+                               supervisor.stats.skipped_quorum))
             # host-plane recovery gauges: retries/recoveries/degraded
             # seams (and injected-fault count when a drill is armed) —
             # host counters, zero extra device syncs
@@ -1107,6 +1161,16 @@ def run_experiment(cfg: ExperimentConfig,
             if injector is not None:
                 row.update(injector.stats())
             tel.round_row(row)
+            if sc["quorum_degraded"] > 0:
+                # a sub-quorum round that committed its renormalized
+                # partial cohort (degrade action) or is about to be
+                # escalated (abort retries exhausted into a skip) —
+                # the per-round operator signal behind the 'degraded'
+                # health intent below
+                tel.event("lifecycle.quorum_degraded", round=r,
+                          n_online=sc["n_online"],
+                          avail_dropped=sc["avail_dropped"],
+                          deadline_missed=sc["deadline_missed"])
             if anomaly is not None:
                 # observe-only EWMA z-score pass over the finished row
                 # (telemetry/anomaly.py): events + report fodder, no
@@ -1131,7 +1195,13 @@ def run_experiment(cfg: ExperimentConfig,
             # that absorbed a host-seam retry, 'running' otherwise —
             # the run IS progressing in all three.
             host_retries_now = recovery.total_retries()
-            if recovery.degraded:
+            quorum_streak = quorum_streak + 1 \
+                if sc["quorum_degraded"] > 0 else 0
+            if recovery.degraded or quorum_streak >= 3:
+                # host seam running degraded, OR the availability
+                # lifecycle committing sub-quorum cohorts for 3+
+                # consecutive rounds — progressing, but an operator
+                # should look (docs/robustness.md "Deployment realism")
                 intent = "degraded"
             elif host_retries_now > host_retries_seen:
                 intent = "recovering"
@@ -1266,6 +1336,12 @@ def run_experiment(cfg: ExperimentConfig,
                 tel.health_update("error")
             elif results.get("preempted"):
                 tel.health_update("preempted")
+            elif quorum_streak >= 3:
+                # the run finished, but its tail was a persistent
+                # sub-quorum streak (availability lifecycle committing
+                # degraded cohorts) — keep the operator signal instead
+                # of overwriting it with a clean 'complete'
+                tel.health_update("degraded")
             else:
                 tel.health_update("complete")
             _uninstall_host_plane()
@@ -1277,6 +1353,8 @@ def run_experiment(cfg: ExperimentConfig,
             "rounds": st.rounds, "retries": st.retries,
             "rollbacks": st.rollbacks,
             "skipped_rounds": st.skipped_rounds,
+            "skipped_fault": st.skipped_fault,
+            "skipped_quorum": st.skipped_quorum,
             "disk_restores": st.disk_restores,
             "all_rejected_rounds": st.all_rejected_rounds,
             "last_good_round": st.last_good_round}

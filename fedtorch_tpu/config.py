@@ -17,6 +17,7 @@ TPU/JAX build:
 from __future__ import annotations
 
 import dataclasses
+import warnings
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -53,6 +54,17 @@ HOST_FAULT_SEAMS = (
     "telemetry.write",  # metrics/events/health file write raises
     "native.load",      # native library load fails -> numpy fallback
 )
+
+# Client-availability models (robustness/availability.py;
+# docs/robustness.md "Deployment realism"). 'default' reproduces the
+# legacy AsyncSchedule draws bitwise (straggler-knob aliasing — the
+# tail-delay Bernoulli off the _DELAY_SALT fold chain, no dropouts);
+# 'trace' is the in-tree synthetic deployment trace: FedScale-style
+# device-class speed multipliers + a diurnal on/off availability curve
+# + mid-round dropout, all threefry draws off the experiment key.
+# Declared here so config validation stays stdlib-only — the jax
+# implementation imports THIS tuple.
+AVAILABILITY_MODELS = ("default", "trace")
 
 FEDERATED_ALGORITHMS = (
     "fedavg", "scaffold", "fedprox", "fedgate", "fedadam", "apfl", "afl",
@@ -470,6 +482,45 @@ class FaultConfig:
     # harness cycles it. 0 (default) = off: no monitor thread, and the
     # traced round program is byte-identical (host-only feature).
     watchdog_timeout_s: float = 0.0
+    # -- deployment realism (robustness/availability.py) ---------------
+    # client-availability model behind AsyncSchedule arrivals and the
+    # sync round lifecycle. 'default' reproduces the legacy scheduler
+    # draws bitwise (straggler-knob aliasing, no dropouts); 'trace'
+    # arms the in-tree synthetic deployment trace: FedScale-style
+    # device-class speed multipliers + a diurnal on/off curve, all
+    # threefry draws off the experiment key so completion order stays a
+    # pure function of (seed, round/commit).
+    avail_model: str = "default"
+    # mid-round dropout probability per dispatched client: a dropped
+    # client never reports (async: its arrival is discarded and its
+    # slot re-dispatched; sync: it is masked out through the accept
+    # seam and surviving weight renormalized)
+    avail_dropout_rate: float = 0.0
+    # rounds per diurnal cycle for the trace model's on/off availability
+    # curve (0 = flat fleet, no diurnal modulation)
+    avail_diurnal_period: int = 0
+    # sync round lifecycle: dispatch ceil(over_select_frac * k_online)
+    # clients and close the round on the first k_online arrivals; the
+    # late tail is masked out through the accept-mask ->
+    # guards.renormalize_accepted seam (1.0 = no over-selection)
+    over_select_frac: float = 1.0
+    # round quorum as a fraction of k_online (0 = no quorum). When
+    # fewer clients report by the deadline, the round either commits
+    # the renormalized partial cohort and is counted+evented as
+    # degraded ('degrade', default — the run never wedges) or is
+    # treated as unhealthy and aborted into the supervisor's
+    # rollback/retry path ('abort'; requires fault.supervisor)
+    avail_quorum_frac: float = 0.0
+    avail_quorum_action: str = "degrade"  # 'degrade' | 'abort'
+
+    @property
+    def avail_armed(self) -> bool:
+        """True when any deployment-realism knob changes the traced
+        round program; disarmed programs stay byte-identical."""
+        return (self.avail_model != "default"
+                or self.avail_dropout_rate > 0.0
+                or self.over_select_frac > 1.0
+                or self.avail_quorum_frac > 0.0)
 
     @property
     def chaos_enabled(self) -> bool:
@@ -799,6 +850,49 @@ class ExperimentConfig:
             raise ValueError(
                 "fault.watchdog_timeout_s must be >= 0 (0 = off), got "
                 f"{flt.watchdog_timeout_s}")
+        if flt.avail_model not in AVAILABILITY_MODELS:
+            raise ValueError(
+                f"fault.avail_model must be one of {AVAILABILITY_MODELS}, "
+                f"got {flt.avail_model!r}")
+        if not 0.0 <= flt.avail_dropout_rate <= 1.0:
+            raise ValueError(
+                "fault.avail_dropout_rate must be in [0, 1], got "
+                f"{flt.avail_dropout_rate}")
+        if flt.avail_diurnal_period < 0:
+            raise ValueError(
+                "fault.avail_diurnal_period must be >= 0 (0 = flat "
+                f"fleet), got {flt.avail_diurnal_period}")
+        if not 1.0 <= flt.over_select_frac <= 4.0:
+            raise ValueError(
+                "fault.over_select_frac must be in [1, 4] (dispatching "
+                "more than 4x the target cohort pays vmap width for "
+                f"nothing), got {flt.over_select_frac}")
+        if not 0.0 <= flt.avail_quorum_frac <= 1.0:
+            raise ValueError(
+                "fault.avail_quorum_frac must be in [0, 1], got "
+                f"{flt.avail_quorum_frac}")
+        if flt.avail_quorum_action not in ("degrade", "abort"):
+            raise ValueError(
+                "fault.avail_quorum_action must be 'degrade' or "
+                f"'abort', got {flt.avail_quorum_action!r}")
+        if flt.avail_quorum_action == "abort" \
+                and flt.avail_quorum_frac > 0.0 and not flt.supervisor:
+            raise ValueError(
+                "fault.avail_quorum_action='abort' routes sub-quorum "
+                "rounds into the round supervisor's rollback/retry "
+                "path — arm fault.supervisor (or use 'degrade', which "
+                "commits the renormalized partial cohort)")
+        if fed.sync_mode == "async" and flt.straggler_rate > 0.0 \
+                and flt.avail_model == "default" and not flt.avail_armed:
+            warnings.warn(
+                "async arrivals driven by the legacy straggler-knob "
+                "aliasing (fault.straggler_rate reinterpreted as an "
+                "arrival tail-delay rate). This spelling is deprecated: "
+                "set fault.avail_model='trace' for the deployment-trace "
+                "arrival model (docs/robustness.md 'Deployment "
+                "realism'). The default model reproduces the legacy "
+                "draws bitwise, so existing A/Bs and resumes stay "
+                "valid.", FutureWarning, stacklevel=2)
         if self.checkpoint.keep_last_n < 0:
             raise ValueError(
                 "checkpoint.keep_last_n must be >= 0 (0 = unlimited), "

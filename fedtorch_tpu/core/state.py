@@ -63,6 +63,16 @@ class RoundMetrics(NamedTuple):
     byzantine_clients: jnp.ndarray = 0.0  # scalar — crafted uploads
     robust_selected: jnp.ndarray = 0.0    # scalar — updates aggregated
     robust_trimmed: jnp.ndarray = 0.0     # scalar — excluded/clipped
+    # deployment-realism round lifecycle (robustness/availability.py,
+    # docs/robustness.md "Deployment realism"): mid-round dropouts,
+    # survivors that reported after the round closed on its first
+    # k_online arrivals, and whether the reporting cohort fell below
+    # the configured quorum (the round still commits its renormalized
+    # partial cohort — degraded, never wedged). All 0 when the
+    # availability plane is disarmed.
+    avail_dropped: jnp.ndarray = 0.0      # scalar — mid-round dropouts
+    deadline_missed: jnp.ndarray = 0.0    # scalar — late survivors
+    quorum_degraded: jnp.ndarray = 0.0    # scalar {0,1} — sub-quorum
     # federation-plane cohort statistics (telemetry.cohort_stats —
     # docs/observability.md "Federation plane"). None (the default)
     # contributes ZERO pytree leaves, so with the gauge off the round

@@ -100,6 +100,7 @@ from fedtorch_tpu.robustness.chaos import (
     BYZ_COHORT_FOLD, BYZ_NOISE_FOLD, apply_byzantine,
     byzantine_cohort_mask, draw_chaos_plan, no_chaos_plan, poison_tree,
 )
+from fedtorch_tpu.robustness.availability import sync_lifecycle
 from fedtorch_tpu.robustness.guards import (
     renormalize_accepted, screen_payloads,
 )
@@ -154,6 +155,18 @@ class FederatedTrainer:
         # static online-client count (online_client_rate, misc.py:14)
         self.k_online = max(
             int(cfg.federated.online_client_rate * self.num_clients), 1)
+        # deployment-realism round lifecycle (robustness/availability.py,
+        # docs/robustness.md "Deployment realism"), sync planes only —
+        # the async plane's arrivals come from its event scheduler.
+        # Over-selection dispatches k' = ceil(over_select_frac * k)
+        # clients (the round closes on the first k reports; the late
+        # tail is masked through the accept seam). Disarmed (the
+        # default), k_dispatch == k_online and every program below
+        # traces byte-identically to the pre-availability engine.
+        self.avail_sync = cfg.fault.avail_armed and not self.supports_async
+        self.k_dispatch = max(math.ceil(
+            cfg.fault.over_select_frac * self.k_online), self.k_online) \
+            if self.avail_sync else self.k_online
 
         # static local-step count per round (flow_utils.py:33-40 epoch /
         # local_step sync modes; epoch mode sizes the scan for the max
@@ -230,7 +243,7 @@ class FederatedTrainer:
         # _fused_client_round keeps every [k] state semantic.
         self.client_fusion, self.fused_module = resolve_client_fusion(
             cfg, model, algorithm, int(self.mesh.devices.size),
-            self.k_online)
+            self.k_dispatch)
         # the round-program builder (parallel/round_program.py): the
         # ONE place programs are composed and cells are refused. The
         # construction-time dispatch ('round' here, 'commit' on the
@@ -347,13 +360,13 @@ class FederatedTrainer:
         # not the norm_bound momentum wrap
         part_aux = server.aux["alg"] if self.robust_momentum \
             else server.aux
-        idx = alg.participation(rng_sample, C, self.k_online, server.round,
-                                part_aux)
+        idx = alg.participation(rng_sample, C, self.k_dispatch,
+                                server.round, part_aux)
         if idx is None:
-            idx = participation_indices(rng_sample, C, self.k_online,
+            idx = participation_indices(rng_sample, C, self.k_dispatch,
                                         server.round)
         on_sizes = jnp.take(data.sizes, idx)
-        rngs = jax.random.split(rng_train, self.k_online)
+        rngs = jax.random.split(rng_train, self.k_dispatch)
         batch_mode = self.gather_mode == "batch"
 
         if batch_mode:
@@ -414,7 +427,7 @@ class FederatedTrainer:
         match the device plane bitwise (tests/test_streaming.py)."""
         rng_round = jax.random.fold_in(server.rng, server.round)
         _rng_sample, rng_train = jax.random.split(rng_round)
-        rngs = jax.random.split(rng_train, self.k_online)
+        rngs = jax.random.split(rng_train, self.k_dispatch)
         # no streamed val plane (gated in __init__): mirror the device
         # path's val_data-None placeholders exactly
         on_vx, on_vy = feed.x[:, :1], feed.y[:, :1]
@@ -493,6 +506,18 @@ class FederatedTrainer:
                 jax.random.fold_in(server.rng, BYZ_COHORT_FOLD),
                 C, flt.byzantine_rate)
             plan = plan._replace(byzantine=jnp.take(cohort, idx))
+
+        # deployment-realism round lifecycle (robustness/availability.py
+        # sync planes only — the async plane's arrivals come from its
+        # event scheduler): per-dispatched-client arrival delays and
+        # mid-round dropouts, the round closing on its first k_online
+        # arrivals. Static gating: disarmed traces the exact
+        # pre-availability program.
+        avail_ok = avail_drop = avail_miss = None
+        if self.avail_sync:
+            avail_ok, avail_drop, avail_miss = sync_lifecycle(
+                server.rng, rng_round, idx, server.round, flt,
+                self.k_online)
 
         # gather online-client state (the per-round new_group)
         take = lambda t: jax.tree.map(lambda x: jnp.take(x, idx, axis=0), t)
@@ -702,17 +727,30 @@ class FederatedTrainer:
         # and the surviving aggregation weight is renormalized so the
         # server step keeps its fault-free magnitude.
         rejected = clipped = jnp.zeros(())
+        # reporters this round: chaos survival AND (availability plane
+        # armed) arrival by the deadline — a dropout or late report
+        # never reaches the server, so it is excluded BEFORE the
+        # guards (it must not influence the median norm) and before
+        # the robust rule; its weight renormalizes away below exactly
+        # like a crashed client's.
+        survive = plan.survive if avail_ok is None \
+            else plan.survive * avail_ok.astype(jnp.float32)
         if self.guard_on:
             payloads, report = screen_payloads(wire_deltas, payloads,
-                                               plan.survive, flt)
+                                               survive, flt)
             accept, rejected, clipped = (report.accept, report.rejected,
                                          report.clipped)
-        elif self.chaos_on:
-            accept = plan.survive
+        elif self.chaos_on or self.avail_sync:
+            accept = survive
             payloads = tree_where(accept, payloads,
                                   tree_zeros_like(payloads))
         else:
             accept = None
+        if self.avail_sync and flt.byzantine_rate > 0.0:
+            # recount attacks that actually reached the server: a
+            # cohort member that dropped out or missed the deadline
+            # never delivered its crafted upload
+            byz_count = jnp.sum(plan.byzantine * survive)
 
         # the aggregation seam: either the plain weighted sum (the
         # pre-robust engine, kept verbatim so --robust_agg mean stays
@@ -802,6 +840,16 @@ class FederatedTrainer:
             new_on_clients = tree_where(plan.survive, new_on_clients,
                                         on_clients0)
             online = plan.survive
+        if self.avail_sync:
+            # a mid-round dropout went offline before finishing: its
+            # local round never happened (fail-stop, like crash
+            # chaos). A deadline miss DID finish training — the client
+            # keeps its local state; only its upload was masked at the
+            # server. ``online`` counts reporters, so the logged
+            # loss/acc are what the server actually observed.
+            new_on_clients = tree_where(~avail_drop, new_on_clients,
+                                        on_clients0)
+            online = online * avail_ok.astype(jnp.float32)
 
         # scatter online client state back into the full [C] axis
         scatter = lambda full, new: jax.tree.map(
@@ -814,8 +862,9 @@ class FederatedTrainer:
         comm_bytes = jnp.asarray(
             tree_bytes(server.params) * k
             * alg.payload_scale(), jnp.float32)
-        if flt.client_drop_rate > 0.0:
-            # crashed uploads never hit the wire
+        if flt.client_drop_rate > 0.0 or self.avail_sync:
+            # crashed / dropped-out / past-deadline uploads never hit
+            # the wire (the server closed the round without them)
             comm_bytes = comm_bytes * jnp.sum(online) / k
 
         new_server = ServerState(params=new_params, opt=new_opt,
@@ -845,10 +894,33 @@ class FederatedTrainer:
                 cohort_staleness=jnp.zeros((k,)),
                 cohort_norm_q=cohort["norm_q"],
                 cohort_dispersion=cohort["disp"])
+        # availability lifecycle counters + the in-jit quorum verdict
+        # (all ride RoundMetrics into the loop's one batched fetch).
+        # The round ALWAYS commits its renormalized partial cohort —
+        # sub-quorum degrades (counted, evented, health intent) or is
+        # escalated by the supervisor when avail_quorum_action='abort';
+        # the program itself never wedges (all-rejected => the
+        # renormalization scale hit 0 and the server held).
+        avail_fields = {}
+        chaos_dropped = k - jnp.sum(online)
+        if self.avail_sync:
+            # keep 'dropped' = chaos crashes only; the availability
+            # plane reports its own counters
+            chaos_dropped = jnp.sum(1.0 - plan.survive)
+            n_report = jnp.sum(accept)
+            q_flag = jnp.zeros(())
+            if flt.avail_quorum_frac > 0.0:
+                quorum = math.ceil(
+                    flt.avail_quorum_frac * self.k_online)
+                q_flag = (n_report < quorum).astype(jnp.float32)
+            avail_fields = dict(
+                avail_dropped=jnp.sum(avail_drop.astype(jnp.float32)),
+                deadline_missed=jnp.sum(avail_miss.astype(jnp.float32)),
+                quorum_degraded=q_flag)
         metrics = RoundMetrics(
             train_loss=loss_full, train_acc=acc_full,
             online_mask=mask_full, comm_bytes=comm_bytes,
-            dropped_clients=k - jnp.sum(online),
+            dropped_clients=chaos_dropped,
             straggler_clients=jnp.sum(
                 (plan.budget_scale < 1.0).astype(jnp.float32)),
             rejected_updates=jnp.asarray(rejected, jnp.float32),
@@ -856,7 +928,7 @@ class FederatedTrainer:
             byzantine_clients=jnp.asarray(byz_count, jnp.float32),
             robust_selected=jnp.asarray(robust_selected, jnp.float32),
             robust_trimmed=jnp.asarray(robust_trimmed, jnp.float32),
-            **cohort_fields)
+            **avail_fields, **cohort_fields)
         return new_server, new_clients, metrics
 
     # -- fused client round (cfg.mesh.client_fusion='fused') --------------
@@ -875,7 +947,7 @@ class FederatedTrainer:
         tests/test_client_fusion.py pins the A/B against the vmap
         path."""
         cfg, model, alg = self.cfg, self.model, self.algorithm
-        K, B, k = self.local_steps, self.batch_size, self.k_online
+        K, B, k = self.local_steps, self.batch_size, self.k_dispatch
         flt = self.fault
         server_params = server.params
         nb = jnp.ceil(sizes / B)  # [k] batches per local epoch
@@ -1050,6 +1122,13 @@ class FederatedTrainer:
             "byzantine": metrics.byzantine_clients,
             "robust_selected": metrics.robust_selected,
             "robust_trimmed": metrics.robust_trimmed,
+            # deployment-realism lifecycle counters (0 when the
+            # availability plane is disarmed) — same single fetch; the
+            # supervisor reads quorum_degraded from here for the
+            # avail_quorum_action='abort' escalation
+            "avail_dropped": metrics.avail_dropped,
+            "deadline_missed": metrics.deadline_missed,
+            "quorum_degraded": metrics.quorum_degraded,
         }
         if metrics.cohort_dispersion is not None:
             # the heterogeneity gauge (telemetry.cohort_stats) rides
@@ -1141,7 +1220,7 @@ class FederatedTrainer:
                 self.host_store, key_data=key_data,
                 key_impl=jax.random.key_impl(server.rng),
                 start_round=int(round0), num_clients=self.num_clients,
-                k_online=self.k_online, local_steps=self.local_steps,
+                k_online=self.k_dispatch, local_steps=self.local_steps,
                 batch_size=self.batch_size, window=window,
                 place_fn=lambda t: replicate(t, mesh))
             # leak guard: a trainer dropped WITHOUT invalidate_stream
@@ -1269,7 +1348,7 @@ class FederatedTrainer:
         lets cost capture lower the streamed program without consuming
         a real prefetched feed from the producer."""
         st = self.host_store
-        k = self.k_online if k is None else k
+        k = self.k_dispatch if k is None else k
         KB = self.local_steps * self.batch_size
         sh = replicated_sharding(self.mesh)
         sds = lambda shape, dt: jax.ShapeDtypeStruct(shape, dt,

@@ -281,7 +281,11 @@ class RoundProgramBuilder:
         validate_cell(
             self.source, dispatch, self.execution, cfg=t.cfg,
             algorithm=t.algorithm, model=t.model,
-            mesh_devices=int(t.mesh.devices.size), k_online=t.k_online,
+            # over-selection widens the cohort the program actually
+            # vmaps/fuses over — validate the dispatch width, not the
+            # close-quorum k_online
+            mesh_devices=int(t.mesh.devices.size),
+            k_online=getattr(t, "k_dispatch", t.k_online),
             gather_mode=t.explicit_gather_mode, has_val=t.has_val,
             # resolve_client_fusion already proved the fused-execution
             # preconditions (same named reasons) — don't rebuild the

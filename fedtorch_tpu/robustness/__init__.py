@@ -1,3 +1,7 @@
+from fedtorch_tpu.robustness.availability import (  # noqa: F401
+    AVAILABILITY_MODELS, AvailabilityModel, DefaultAvailability,
+    TraceAvailability, make_availability_model, synthesize_trace,
+)
 from fedtorch_tpu.robustness.aggregators import (  # noqa: F401
     ROBUST_AGGREGATORS, RobustReport, krum_selection, robust_aggregate,
 )

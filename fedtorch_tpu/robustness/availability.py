@@ -1,0 +1,307 @@
+"""Deterministic client-availability models (the deployment-realism
+plane, docs/robustness.md "Deployment realism").
+
+The reference paper's MPI deployment implicitly assumes every selected
+client reports every round; production cross-device FL serves a
+diurnal, heterogeneous fleet where clients drop out mid-round and the
+server closes rounds on report deadlines (Bonawitz et al. 2019; device
+traces in FedScale, Lai et al. 2022). This module supplies the arrival
+process behind BOTH federation planes:
+
+* **async** — installed behind ``AsyncSchedule._draw_delays``: every
+  per-dispatch completion delay, straggler flag and mid-round dropout
+  is a threefry draw off the experiment key, so client completion
+  order stays a pure function of (seed, commit) and fast-forward
+  resume / bitwise replay / trace-once are preserved.
+* **sync** — :func:`sync_lifecycle` runs INSIDE the jitted round
+  program: over-selected cohorts draw per-client arrival delays and
+  dropouts off ``rng_round``, the round closes on the first
+  ``k_online`` arrivals, and the late tail is masked out through the
+  existing accept-mask -> ``guards.renormalize_accepted`` seam.
+
+Models (``config.AVAILABILITY_MODELS``):
+
+``default``
+    Reproduces the legacy scheduler draws BITWISE — the tail-delay
+    Bernoulli off the ``LEGACY_DELAY_SALT`` fold chain with
+    ``fault.straggler_rate`` / ``straggler_step_frac`` aliased as
+    arrival knobs, and no dropouts unless ``avail_dropout_rate`` is
+    armed (which adds an independent draw without perturbing the
+    legacy chain). Existing A/Bs and checkpoint fast-forwards stay
+    valid; pinned in tests/test_availability.py.
+
+``trace``
+    The in-tree synthetic deployment trace (zero-egress container —
+    no FedScale download): per-client FedScale-style device classes
+    (speed multipliers drawn once per run key) and a diurnal on/off
+    availability curve (per-client phase; ``avail_diurnal_period``
+    rounds per cycle) modulating the mid-round dropout probability.
+
+All fold constants here are fresh (< 2^31, disjoint from chaos_salt
+0x7FFFFFFD, the augmentation parent 0x7FFFFFFF, ASYNC_TRAIN_SALT
+0x7FFFFFF9, the scheduler's 0x7FFFFFF7/0x7FFFFFF5, RESEED_SALT
+0x5EED0000 and the small in-round folds).
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedtorch_tpu.config import AVAILABILITY_MODELS, FaultConfig
+
+__all__ = [
+    "AVAILABILITY_MODELS", "AvailabilityModel", "DefaultAvailability",
+    "TraceAvailability", "make_availability_model", "synthesize_trace",
+    "sync_lifecycle", "DEVICE_CLASSES",
+]
+
+# the legacy per-dispatch delay salt — the 'default' model reproduces
+# the scheduler's historical fold chain bitwise, so the constant's
+# source of truth moves here (scheduler re-exports it as _DELAY_SALT)
+LEGACY_DELAY_SALT = 0x7FFFFFF7
+# fresh streams for the deployment-realism plane
+AVAIL_DELAY_SALT = 0x7FFFFFF3   # trace-model per-dispatch delay draw
+AVAIL_CLASS_SALT = 0x7FFFFFF1   # per-client device class + diurnal phase
+AVAIL_DROP_SALT = 0x7FFFFFEF    # per-dispatch mid-round dropout draw
+AVAIL_SYNC_SALT = 0x7FFFFFED    # sync-plane in-jit lifecycle draws
+
+# FedScale-style device classes as (population fraction, speed
+# multiplier): half the fleet is fast phones, a third mid-tier (2x
+# slower), the rest low-end (4x slower — these are the trace model's
+# 'stragglers'). Class assignment is one uniform per client off the
+# run key, so the fleet composition is a pure function of the seed.
+DEVICE_CLASSES = ((0.5, 1.0), (0.3, 2.0), (0.2, 4.0))
+_SLOW_MULT = DEVICE_CLASSES[-1][1]
+
+
+def _class_draw(key: jax.Array, clients: jax.Array):
+    """Per-client (speed multiplier, diurnal phase) — jittable. One
+    uniform pair per client off ``fold_in(key, AVAIL_CLASS_SALT)``;
+    the class boundaries are the cumulative population fractions."""
+    ckey = jax.random.fold_in(key, AVAIL_CLASS_SALT)
+    u = jax.vmap(lambda c: jax.random.uniform(
+        jax.random.fold_in(ckey, c), (2,)))(clients)
+    edges, mults = [], []
+    acc = 0.0
+    for frac, mult in DEVICE_CLASSES:
+        acc += frac
+        edges.append(acc)
+        mults.append(mult)
+    mult = jnp.full(clients.shape, mults[-1], jnp.float32)
+    for edge, m in zip(reversed(edges[:-1]), reversed(mults[:-1])):
+        mult = jnp.where(u[:, 0] < edge, jnp.float32(m), mult)
+    return mult, u[:, 1]  # [n] multiplier, [n] phase in [0, 1)
+
+
+def _offness(t, phase, period: int):
+    """Diurnal 'off-ness' in [0, 1]: 0 at each client's peak, 1 at its
+    trough, neutral 0.5 for a flat fleet (period 0). Works on python
+    scalars, numpy and traced arrays alike."""
+    if period <= 0:
+        return 0.5 * jnp.ones_like(phase) if hasattr(phase, "shape") \
+            else 0.5
+    lib = jnp if hasattr(phase, "aval") or hasattr(t, "aval") else np
+    return 0.5 - 0.5 * lib.cos(
+        2.0 * lib.pi * (lib.asarray(t, lib.float32) / period + phase))
+
+
+class AvailabilityModel:
+    """One arrival model for the async scheduler's host event loop.
+
+    Split in two so the scheduler keeps its one jitted draw per
+    dispatch on the CPU backend (threefry = backend-deterministic):
+    :meth:`traced` is the jittable column draw, :meth:`finish` the
+    float64 host math turning columns into (delay, straggler,
+    dropped). Both are pure functions of their inputs."""
+
+    name: str = "base"
+
+    def traced(self, key, dispatch_ids, clients, versions):
+        raise NotImplementedError
+
+    def finish(self, u: np.ndarray, versions: np.ndarray
+               ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        raise NotImplementedError
+
+
+class DefaultAvailability(AvailabilityModel):
+    """The legacy scheduler draws, bitwise: ``u = uniform(fold_in(
+    fold_in(key, LEGACY_DELAY_SALT), dispatch_id), (2,))``, ``base = 1
+    + jitter*u1``, straggler iff ``u0 < rate`` (then ``base/
+    straggler_step_frac``). ``avail_dropout_rate > 0`` adds an
+    INDEPENDENT third column off AVAIL_DROP_SALT — the legacy chain is
+    never perturbed, so arming dropout changes which arrivals commit
+    but not when anything arrives."""
+
+    name = "default"
+
+    def __init__(self, *, straggler_rate: float,
+                 straggler_step_frac: float, jitter: float = 0.25,
+                 dropout_rate: float = 0.0):
+        self._rate = float(straggler_rate)
+        self._tail = 1.0 / float(straggler_step_frac)
+        self._jitter = float(jitter)
+        self._drop = float(dropout_rate)
+
+    def traced(self, key, dispatch_ids, clients, versions):
+        del clients, versions
+        rngs = jax.vmap(lambda d: jax.random.fold_in(
+            jax.random.fold_in(key, LEGACY_DELAY_SALT), d))(dispatch_ids)
+        u = jax.vmap(lambda r: jax.random.uniform(r, (2,)))(rngs)
+        if self._drop <= 0.0:
+            return u
+        dkey = jax.random.fold_in(key, AVAIL_DROP_SALT)
+        ud = jax.vmap(lambda d: jax.random.uniform(
+            jax.random.fold_in(dkey, d), (1,)))(dispatch_ids)
+        return jnp.concatenate([u, ud], axis=1)
+
+    def finish(self, u, versions):
+        del versions
+        base = 1.0 + self._jitter * u[:, 1]
+        straggler = u[:, 0] < self._rate
+        delay = np.where(straggler, base * self._tail, base)
+        dropped = (u[:, 2] < self._drop) if u.shape[1] > 2 \
+            else np.zeros(u.shape[0], bool)
+        return delay, straggler, dropped
+
+
+class TraceAvailability(AvailabilityModel):
+    """The synthetic deployment trace: delay = (1 + jitter*u) x the
+    client's device-class multiplier; 'straggler' = a low-end-class
+    dispatch (the counter keeps its meaning: the dispatches that set
+    the tail); dropout probability = ``2 * avail_dropout_rate x
+    off-ness`` of the client's diurnal curve at its dispatch version
+    (mean over a cycle = the configured rate; clipped to [0, 1])."""
+
+    name = "trace"
+
+    def __init__(self, *, dropout_rate: float, diurnal_period: int,
+                 jitter: float = 0.25):
+        self._drop = float(dropout_rate)
+        self._period = int(diurnal_period)
+        self._jitter = float(jitter)
+
+    def traced(self, key, dispatch_ids, clients, versions):
+        del versions
+        dkey = jax.random.fold_in(key, AVAIL_DELAY_SALT)
+        uj = jax.vmap(lambda d: jax.random.uniform(
+            jax.random.fold_in(dkey, d), (1,)))(dispatch_ids)
+        mult, phase = _class_draw(key, clients)
+        pkey = jax.random.fold_in(key, AVAIL_DROP_SALT)
+        ud = jax.vmap(lambda d: jax.random.uniform(
+            jax.random.fold_in(pkey, d), (1,)))(dispatch_ids)
+        return jnp.concatenate(
+            [uj, mult[:, None], phase[:, None], ud], axis=1)
+
+    def finish(self, u, versions):
+        delay = (1.0 + self._jitter * u[:, 0]) * u[:, 1]
+        straggler = u[:, 1] >= _SLOW_MULT
+        off = np.asarray(_offness(np.asarray(versions, np.float64),
+                                  u[:, 2], self._period))
+        p = np.clip(2.0 * self._drop * off, 0.0, 1.0)
+        return delay, straggler, u[:, 3] < p
+
+
+def make_availability_model(fault: FaultConfig,
+                            jitter: float = 0.25) -> AvailabilityModel:
+    """The one constructor the async plane uses (``commit.py
+    _schedule_args`` -> ``AsyncSchedule``). The default model with
+    dropout off is the pre-availability scheduler, bitwise."""
+    if fault.avail_model == "trace":
+        return TraceAvailability(
+            dropout_rate=fault.avail_dropout_rate,
+            diurnal_period=fault.avail_diurnal_period, jitter=jitter)
+    return DefaultAvailability(
+        straggler_rate=fault.straggler_rate,
+        straggler_step_frac=fault.straggler_step_frac, jitter=jitter,
+        dropout_rate=fault.avail_dropout_rate)
+
+
+def synthesize_trace(key_data, key_impl, num_clients: int,
+                     diurnal_period: int = 0) -> dict:
+    """The in-tree synthetic trace generator (zero-egress stand-in for
+    a FedScale device trace): materializes the per-client fleet the
+    'trace' model draws from — device-class id, speed multiplier and
+    diurnal phase for every client, as host numpy. Used by the
+    availability drill and docs, NOT by the hot path (the model
+    re-derives the same values in-jit per dispatch)."""
+    key = jax.random.wrap_key_data(
+        jnp.asarray(np.asarray(key_data)), impl=key_impl)
+    # lint: disable=FTL004 — one-shot cold path; inputs are tiny
+    mult, phase = jax.jit(_class_draw, static_argnums=())(
+        key, jnp.arange(num_clients, dtype=jnp.int32))
+    mult = np.asarray(jax.device_get(mult))
+    phase = np.asarray(jax.device_get(phase))
+    class_id = np.searchsorted(
+        np.asarray(sorted({m for _, m in DEVICE_CLASSES})), mult)
+    return {"class_id": class_id.astype(np.int32),
+            "speed_multiplier": mult.astype(np.float32),
+            "diurnal_phase": phase.astype(np.float32),
+            "diurnal_period": int(diurnal_period),
+            "classes": [{"fraction": f, "multiplier": m}
+                        for f, m in DEVICE_CLASSES]}
+
+
+def sync_lifecycle(server_rng, rng_round, idx, round_idx,
+                   fault: FaultConfig, k_online: int,
+                   jitter: float = 0.25):
+    """The sync plane's in-jit round lifecycle (called from
+    ``_round_core`` only when ``fault.avail_armed``).
+
+    Over-selection dispatched ``k' = len(idx) >= k_online`` clients;
+    this draws each one's virtual arrival delay and mid-round dropout
+    off ``fold_in(rng_round, AVAIL_SYNC_SALT)`` (per-client fold —
+    pure function of (seed, round, client)), closes the round on the
+    first ``k_online`` arrivals, and returns:
+
+    ``accept``        [k'] bool — reported by the deadline (the mask
+                      ANDed into the chaos/guard accept seam)
+    ``dropped``       [k'] bool — mid-round dropouts
+    ``deadline_miss`` [k'] bool — survived but arrived late
+
+    Device classes (trace model) are drawn off ``server_rng`` so a
+    client's speed is stable across rounds; the supervisor's
+    reseed-on-retry rotates ``server_rng`` and thus redraws the
+    schedule — exactly the fresh-draw semantics retries want.
+    """
+    k = idx.shape[0]
+    ukey = jax.random.fold_in(rng_round, AVAIL_SYNC_SALT)
+    u = jax.vmap(lambda c: jax.random.uniform(
+        jax.random.fold_in(ukey, c), (2,)))(idx)
+    if fault.avail_model == "trace":
+        mult, phase = _class_draw(server_rng, idx)
+        delay = (1.0 + jitter * u[:, 1]) * mult
+        off = _offness(round_idx, phase, fault.avail_diurnal_period)
+        p_drop = jnp.clip(2.0 * fault.avail_dropout_rate * off,
+                          0.0, 1.0)
+    else:
+        base = 1.0 + jitter * u[:, 1]
+        tail = 1.0 / float(fault.straggler_step_frac)
+        delay = jnp.where(u[:, 0] < fault.straggler_rate, base * tail,
+                          base)
+        p_drop = jnp.float32(fault.avail_dropout_rate)
+        # the default model's dropout draw must be independent of the
+        # arrival draw: re-fold the drop salt per client
+        if fault.avail_dropout_rate > 0.0:
+            dkey = jax.random.fold_in(rng_round, AVAIL_DROP_SALT)
+            u_drop = jax.vmap(lambda c: jax.random.uniform(
+                jax.random.fold_in(dkey, c), ()))(idx)
+        else:
+            u_drop = jnp.ones((k,))
+    if fault.avail_model == "trace":
+        dkey = jax.random.fold_in(rng_round, AVAIL_DROP_SALT)
+        u_drop = jax.vmap(lambda c: jax.random.uniform(
+            jax.random.fold_in(dkey, c), ()))(idx)
+    dropped = u_drop < p_drop
+    # dropouts never arrive: rank them behind every survivor, then the
+    # first k_online of the effective order make the deadline
+    eff = jnp.where(dropped, jnp.inf, delay)
+    order = jnp.argsort(eff)
+    rank = jnp.argsort(order)
+    deadline_ok = rank < k_online
+    accept = deadline_ok & ~dropped
+    deadline_miss = ~dropped & ~deadline_ok
+    return accept, dropped, deadline_miss

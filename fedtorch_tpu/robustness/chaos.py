@@ -16,11 +16,18 @@ Fault semantics (docs/robustness.md):
   server (payload masked out of aggregation, surviving weights
   renormalized by the engine) and its local state rolls back to the
   round start, exactly as if the process died before its sync.
-* **straggler** — the client misses the round deadline after completing
-  ``ceil(straggler_step_frac * budget)`` of its local steps. This rides
-  the epoch-sync freeze mask: the lockstep scan keeps running but the
-  straggler's state/metrics freeze at the cutoff, and its (partial)
-  update still aggregates — the FedAvg deadline model.
+* **straggler** — a SLOW client whose step budget is cut to
+  ``ceil(straggler_step_frac * budget)``. This rides the epoch-sync
+  freeze mask: the lockstep scan keeps running but the straggler's
+  state/metrics freeze at the cutoff, and its (partial) update still
+  aggregates — the "partial work" model (FedProx-style), NOT a
+  deadline miss. An actual round deadline — the round closing on its
+  first k arrivals and masking late reporters out of aggregation —
+  is the availability lifecycle's job (robustness/availability.py
+  over-selection + deadline masking; docs/robustness.md "Deployment
+  realism"). On the async plane the same straggler knobs instead
+  stretch ARRIVAL delays (the default availability model), so there a
+  straggler commits late-and-stale rather than partial.
 * **nan poison** — the client uploads a non-finite delta (sensor
   corruption, fp overflow, or an adversary). The chaos layer injects it
   at the wire so the server-side guards (guards.py) can be exercised end

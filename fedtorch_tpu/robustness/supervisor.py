@@ -19,8 +19,20 @@ whatever checkpoint exists. The supervisor wraps
 4. after ``fault.max_retries`` failed retries, degrade gracefully: keep
    the rolled-back (healthy) state, advance the round counter (the
    round is SKIPPED, not silently re-run forever), and invoke the
-   ``on_degrade`` hook — the place to e.g. scale the learning rate
-   down or alert an operator.
+   ``on_round_skipped(round_idx, cause)`` and ``on_degrade`` hooks —
+   the place to e.g. scale the learning rate down or alert an
+   operator.
+
+Skips carry a CAUSE: ``"fault"`` (divergence or a raising round
+program exhausted its retries) vs ``"quorum"`` (the deployment-realism
+lifecycle reported a sub-quorum cohort and
+``fault.avail_quorum_action='abort'`` escalates it here instead of
+committing the degraded partial aggregate — see
+robustness/availability.py and docs/robustness.md "Deployment
+realism"). A quorum abort retries exactly like divergence — the retry
+reseed draws a fresh participation/availability schedule, which is the
+whole point of aborting — and only skips when every attempt stayed
+below quorum.
 
 If the in-memory snapshot is itself sick (the caller handed in diverged
 state), the supervisor falls back to the last on-disk checkpoint when a
@@ -71,6 +83,11 @@ class SupervisorStats:
     retries: int = 0
     rollbacks: int = 0
     skipped_rounds: int = 0
+    # skipped_rounds split by cause (skipped_rounds stays the total):
+    # "fault" = divergence / raising program; "quorum" = sub-quorum
+    # cohort under avail_quorum_action='abort'
+    skipped_fault: int = 0
+    skipped_quorum: int = 0
     disk_restores: int = 0
     # rounds where the guards rejected EVERY surviving update (renorm
     # scale 0 — the server held; see guards.all_rejected_scalars)
@@ -105,6 +122,7 @@ class RoundSupervisor:
                  on_degrade: Optional[Callable] = None,
                  on_all_rejected: Optional[Callable] = None,
                  on_host_fault: Optional[Callable] = None,
+                 on_round_skipped: Optional[Callable] = None,
                  logger=None, sleep_fn: Callable[[float], None] = time.sleep):
         self.trainer = trainer
         self.fault = fault if fault is not None else trainer.cfg.fault
@@ -126,6 +144,12 @@ class RoundSupervisor:
         # operator escalates — e.g. switch data_plane, page someone —
         # when one seam keeps failing
         self.on_host_fault = on_host_fault
+        # operator hook for every skipped round, called as
+        # on_round_skipped(round_idx, cause) with cause in
+        # {"fault", "quorum"} BEFORE on_degrade — the cause split is
+        # the operator signal (a run skipping on quorum wants more
+        # over-selection or a lower quorum, not a numerics bisect)
+        self.on_round_skipped = on_round_skipped
         self.logger = logger
         self.sleep_fn = sleep_fn
         self.stats = SupervisorStats()
@@ -165,6 +189,20 @@ class RoundSupervisor:
             if ema is not None and loss > f * ema:
                 return False
         return True
+
+    def _quorum_abort(self) -> bool:
+        """True when the round just health-checked reported a
+        sub-quorum cohort AND the config escalates that here instead
+        of committing the degraded partial aggregate. Reads the
+        ``quorum_degraded`` flag off the same batched fetch
+        ``_round_health`` already paid for (getattr: fakes/mocks in
+        tests may carry a bare fault object)."""
+        flt = self.fault
+        if getattr(flt, "avail_quorum_action", "degrade") != "abort" \
+                or getattr(flt, "avail_quorum_frac", 0.0) <= 0.0:
+            return False
+        s = self.last_scalars or {}
+        return s.get("quorum_degraded", 0.0) > 0.0
 
     def _note_healthy(self, health: dict) -> None:
         st = self.stats
@@ -229,6 +267,7 @@ class RoundSupervisor:
         round_idx = int(jax.device_get(server.round))
         last_exc: Optional[Exception] = None
         produced_state = False
+        cause = "fault"
 
         for attempt in range(flt.max_retries + 1):
             try:
@@ -237,7 +276,17 @@ class RoundSupervisor:
                 jax.block_until_ready(out_s.params)
                 produced_state = True
                 health = self._round_health(out_s, out_c, metrics)
-                if self._healthy(health):
+                healthy = self._healthy(health)
+                if healthy and self._quorum_abort():
+                    # numerically healthy but sub-quorum under the
+                    # 'abort' action: roll back and retry like a
+                    # divergence — the reseed draws a fresh
+                    # availability schedule
+                    cause = "quorum"
+                    self.last_scalars = None
+                    why = ("reporting cohort below quorum "
+                           "(avail_quorum_action='abort')")
+                elif healthy:
                     self._note_healthy(health)
                     if (self.fault.guard_updates
                             or self.fault.chaos_enabled) \
@@ -259,10 +308,13 @@ class RoundSupervisor:
                             self.on_all_rejected(health["round"] - 1,
                                                  self.last_scalars)
                     return out_s, out_c, metrics
-                self.last_scalars = None  # unhealthy: don't log these
-                why = "non-finite server params or loss blow-up"
+                else:
+                    cause = "fault"
+                    self.last_scalars = None  # unhealthy: don't log
+                    why = "non-finite server params or loss blow-up"
             except Exception as e:  # XLA runtime / dispatch failures
                 last_exc = e
+                cause = "fault"
                 why = f"round program raised: {e!r}"
                 seam = getattr(e, "seam", None)
                 if seam is not None:
@@ -304,11 +356,18 @@ class RoundSupervisor:
 
         # degrade: keep the healthy rolled-back state, skip the round
         self.stats.skipped_rounds += 1
+        if cause == "quorum":
+            self.stats.skipped_quorum += 1
+        else:
+            self.stats.skipped_fault += 1
         telemetry.event("supervisor.round_skipped", round=round_idx,
-                        attempts=flt.max_retries + 1)
+                        attempts=flt.max_retries + 1, cause=cause)
         server = server._replace(round=server.round + 1)
         self._log(f"supervisor: round {round_idx} skipped after "
-                  f"{flt.max_retries + 1} attempts; state rolled back")
+                  f"{flt.max_retries + 1} attempts (cause={cause}); "
+                  "state rolled back")
+        if self.on_round_skipped is not None:
+            self.on_round_skipped(round_idx, cause)
         if self.on_degrade is not None:
             replaced = self.on_degrade(server, clients, self.stats)
             if replaced is not None:

@@ -26,11 +26,12 @@ from __future__ import annotations
 import math
 from typing import Dict, List, Optional, Tuple
 
-# metrics-row fields watched by default. ``reject_rate`` is derived
-# (rejected / max(n_online, 1)) — the raw count scales with k and
-# would alias cohort-size changes into anomalies.
+# metrics-row fields watched by default. ``reject_rate``,
+# ``dropout_rate`` and ``deadline_miss_rate`` are derived (count /
+# max(n_online, 1)) — the raw counts scale with k and would alias
+# cohort-size changes into anomalies.
 ANOMALY_FIELDS = ("loss", "cohort_dispersion", "reject_rate",
-                  "staleness")
+                  "staleness", "dropout_rate", "deadline_miss_rate")
 
 
 class EwmaAnomalyDetector:
@@ -56,6 +57,15 @@ class EwmaAnomalyDetector:
         out = {}
         if "rejected" in row and "n_online" in row:
             out["reject_rate"] = float(row["rejected"]) \
+                / max(float(row["n_online"]), 1.0)
+        # availability-lifecycle rates (robustness/availability.py):
+        # a dropout or deadline-miss burst is a deployment-health
+        # signal even before quorum degrades
+        if "avail_dropped" in row and "n_online" in row:
+            out["dropout_rate"] = float(row["avail_dropped"]) \
+                / max(float(row["n_online"]), 1.0)
+        if "deadline_missed" in row and "n_online" in row:
+            out["deadline_miss_rate"] = float(row["deadline_missed"]) \
                 / max(float(row["n_online"]), 1.0)
         return out
 

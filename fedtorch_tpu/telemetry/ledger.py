@@ -15,7 +15,7 @@ replaced ``client_ledger.json`` that elastic restarts adopt like
 Memory contract — **O(min(C, sketch_budget)) at any population**:
 
 * ``C <= sketch_budget`` — **dense** mode: one numpy counter array per
-  tracked quantity (7 x 8 bytes/client; ~3.5 MiB at the default
+  tracked quantity (8 x 8 bytes/client; ~4 MiB at the default
   65536 budget).
 * ``C > sketch_budget`` — **sketch** mode: a count-min sketch (depth
   ``_CM_DEPTH``, width ``budget // depth``) answers per-client
@@ -30,6 +30,8 @@ Per-round semantics for an online client (all O(k) numpy updates):
 ``participation`` += 1 (sampled/dispatched), ``online`` += survived
 chaos, ``accepted`` += passed the guards, ``rejected`` += survived but
 guard-rejected, ``selected`` += the robust rule aggregated it,
+``dropped`` += dispatched but never reported (chaos crash,
+availability dropout, or deadline miss),
 ``suspicion`` += the rule's per-client score
 (robustness/aggregators.py:RobustReport), ``staleness`` += commit
 staleness (async plane; 0 on sync).
@@ -66,11 +68,15 @@ LEDGER_SCHEMA = "fedtorch_tpu.client_ledger/v1"
 LEDGER_FILE = "client_ledger.json"
 
 # per-client quantities the ledger accumulates; integer-count semantics
-# for the first five, float sums for the last two
+# for the first six, float sums for the last two. ``dropped`` is
+# derived per round as participation - online: the client was
+# dispatched but never reported (chaos crash, availability dropout, or
+# deadline miss — the deployment-realism lifecycle's per-client
+# accounting, docs/robustness.md "Deployment realism")
 LEDGER_COUNTERS = ("participation", "online", "accepted", "rejected",
-                   "selected", "suspicion", "staleness")
+                   "selected", "dropped", "suspicion", "staleness")
 _INT_COUNTERS = ("participation", "online", "accepted", "rejected",
-                 "selected")
+                 "selected", "dropped")
 
 # count-min geometry (sketch mode): classic (depth, width) trade —
 # 4 rows bound the overestimate at ~e^-4 failure odds per query
@@ -215,6 +221,7 @@ class ClientLedger:
         suspicion = np.asarray(led["suspicion"], np.float64).ravel()
         staleness = np.asarray(led["staleness"], np.float64).ravel()
         rejected = np.maximum(online - accept, 0.0)
+        dropped = np.maximum(1.0 - online, 0.0)
         self.rounds += 1
         if self.mode == "dense":
             d = self._dense
@@ -223,6 +230,7 @@ class ClientLedger:
             np.add.at(d["accepted"], idx, accept.astype(np.int64))
             np.add.at(d["rejected"], idx, rejected.astype(np.int64))
             np.add.at(d["selected"], idx, selected.astype(np.int64))
+            np.add.at(d["dropped"], idx, dropped.astype(np.int64))
             np.add.at(d["suspicion"], idx, suspicion)
             np.add.at(d["staleness"], idx, staleness)
         else:
@@ -235,6 +243,7 @@ class ClientLedger:
                     "accepted": float(accept[i]),
                     "rejected": float(rejected[i]),
                     "selected": float(selected[i]),
+                    "dropped": float(dropped[i]),
                     "suspicion": float(suspicion[i]),
                     "staleness": float(staleness[i])})
         self._rounds_since_flush += 1
@@ -413,6 +422,10 @@ def validate_client_ledger(doc: Dict) -> None:
         if not isinstance(counters, dict):
             raise ValueError("dense ledger missing 'counters'")
         for name in LEDGER_COUNTERS:
+            if name == "dropped" and name not in counters:
+                # added after v1 shipped; absent in older run dirs —
+                # readers backfill zeros (read_client_ledger)
+                continue
             vals = counters.get(name)
             if not isinstance(vals, list) \
                     or len(vals) != doc["num_clients"]:
@@ -435,6 +448,13 @@ def read_client_ledger(path: str) -> Dict:
     with open(path) as f:
         doc = json.load(f)
     validate_client_ledger(doc)
+    # backfill the post-v1 'dropped' counter for older run dirs so
+    # every consumer sees the full LEDGER_COUNTERS surface
+    if doc["mode"] == "dense" and "dropped" not in doc["counters"]:
+        doc["counters"]["dropped"] = [0] * doc["num_clients"]
+    elif doc["mode"] == "sketch":
+        for rec in doc["top"].values():
+            rec.setdefault("dropped", 0)
     return doc
 
 

@@ -58,6 +58,14 @@ METRICS_OPTIONAL = {
     "robust_trimmed": "updates the robust rule excluded/clipped "
                       "beyond the guards",
     "staleness": "mean snapshot staleness this commit (async plane)",
+    # deployment-realism availability lifecycle
+    # (robustness/availability.py; docs/robustness.md "Deployment
+    # realism")
+    "avail_dropped": "mid-round client dropouts (sync lifecycle)",
+    "deadline_missed": "late survivors masked after the round closed "
+                       "on its first k arrivals (over-selection)",
+    "quorum_degraded": "1 when the accepted cohort fell below the "
+                       "configured quorum this round",
     "mean_epoch": "mean training epoch over real clients",
     # per-round host phase wall-clock (seconds)
     "fetch_s": "batched scalar-fetch wall (blocks on the round)",
@@ -83,6 +91,8 @@ METRICS_OPTIONAL = {
     "async_ring_clamped": "arrivals older than the snapshot ring",
     "async_buffer": "buffer size m (updates folded per commit)",
     "async_commit_rate": "commits per virtual time unit so far",
+    "async_dropouts": "mid-round dropouts discarded at arrival and "
+                      "re-dispatched (availability model)",
     # checkpoint IO (AsyncCheckpointer.stats)
     "ckpt_queue_depth": "writes queued behind the worker",
     "ckpt_writes": "checkpoints durably written so far",
@@ -97,6 +107,10 @@ METRICS_OPTIONAL = {
     "sup_rollbacks": "supervisor rollbacks so far",
     "sup_retries": "supervisor retries so far",
     "sup_skipped": "supervisor skipped rounds so far",
+    "sup_skipped_fault": "skips caused by divergence or a raising "
+                         "round program",
+    "sup_skipped_quorum": "skips caused by sub-quorum rounds under "
+                          "avail_quorum_action='abort'",
     # host-plane chaos + self-healing (robustness/host_chaos.py,
     # robustness/host_recovery.py; docs/robustness.md "Host plane")
     "host_faults": "injected host-seam faults fired so far (armed "

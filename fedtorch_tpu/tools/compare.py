@@ -128,7 +128,9 @@ def compare_runs(dir_a: str, dir_b: str) -> Dict:
     for key in ("model_flops_utilization", "hbm_program_peak_bytes",
                 "hbm_live_bytes", "round_device_min_s",
                 "round_host_frac", "stream_depth", "ckpt_queue_depth",
-                "async_commit_rate", "cohort_dispersion"):
+                "async_commit_rate", "async_dropouts",
+                "cohort_dispersion", "avail_dropped", "deadline_missed",
+                "quorum_degraded"):
         add(f"gauge.{key}", _mean_gauge(rows_a, key),
             _mean_gauge(rows_b, key))
     ov_a, ov_b = sum_a.get("overlap"), sum_b.get("overlap")

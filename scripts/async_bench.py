@@ -22,6 +22,11 @@ key, async_plane/scheduler.py), so the A/B compares:
   (RecompilationSentinel), plus the scheduler's straggler/ring-clamp
   counters.
 
+A third ``async_trace`` leg reruns the async side under the
+deployment-realism availability model (robustness/availability.py:
+device-class delays + diurnal dropouts) at the same commit budget —
+the default sync/async legs keep the legacy delay chain bitwise.
+
 Writes ASYNC_AB.json (ASYNC_AB_PATH overrides, for the test smoke).
 ASYNC_BENCH_SMOKE=1 shrinks the workload for CPU CI.
 
@@ -75,7 +80,8 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def build(sync_mode: str, num_comms: int):
+def build(sync_mode: str, num_comms: int, fault_extra: dict = None):
+    fault_kwargs = dict(straggler_heavy_fault(), **(fault_extra or {}))
     cfg = ExperimentConfig(
         data=DataConfig(dataset="synthetic", synthetic_dim=30,
                         batch_size=BATCH, synthetic_alpha=0.5,
@@ -89,7 +95,7 @@ def build(sync_mode: str, num_comms: int):
                           mlp_hidden_size=64),
         optim=OptimConfig(lr=0.5, weight_decay=0.0),
         train=TrainConfig(local_step=K),
-        fault=FaultConfig(**straggler_heavy_fault()),
+        fault=FaultConfig(**fault_kwargs),
     ).finalize()
     data = build_federated_data(cfg)
     model = define_model(cfg, batch_size=BATCH)
@@ -186,12 +192,49 @@ def main():
         "staleness_mean": round(stale, 3),
         "scheduler": {"dispatches": stats.dispatches,
                       "stragglers": stats.stragglers,
-                      "ring_clamped": stats.staleness_clamped},
+                      "ring_clamped": stats.staleness_clamped,
+                      "dropouts": stats.dropouts},
     }
     tr.invalidate_stream()
     log(f"async: top1 {acc_a:.4f}  {dt_a*1e3:.1f} ms/commit  "
         f"virtual {vtotal_a/commits:.2f}/commit  "
         f"staleness {stale:.2f}")
+
+    # -- async leg, trace availability model -----------------------------
+    # same commit budget, but arrivals drawn from the deployment-realism
+    # trace (robustness/availability.py): device-class delay multipliers
+    # + diurnal mid-round dropouts. The default legs above are untouched
+    # (their delay model is the legacy chain, bitwise), so this leg
+    # measures what deployment realism costs the commit cadence.
+    cfg, tr, data = build("async", commits,
+                          fault_extra={"avail_model": "trace",
+                                       "avail_dropout_rate": 0.1,
+                                       "avail_diurnal_period": 8})
+    server, dt_t, retraces_t, stale_t = timed(tr, commits)
+    acc_t = float(evaluate(tr.model, server.params, data.test_x,
+                           data.test_y).top1)
+    ct_t = np.asarray(tr._sched.commit_times)
+    stats_t = tr.schedule_stats
+    vtotal_t = float(ct_t[-1])
+    out["modes"]["async_trace"] = {
+        "top1": round(acc_t, 4),
+        "ms_per_commit_wall": round(dt_t * 1e3, 2),
+        "retraces_during_timed": retraces_t,
+        "virtual_time_total": round(vtotal_t, 3),
+        "virtual_mean_step_interval": round(vtotal_t / commits, 3),
+        "commits_per_virtual_unit": round(commits / vtotal_t, 4),
+        "client_updates_per_virtual_unit": round(
+            commits * m / vtotal_t, 4),
+        "staleness_mean": round(stale_t, 3),
+        "scheduler": {"dispatches": stats_t.dispatches,
+                      "stragglers": stats_t.stragglers,
+                      "ring_clamped": stats_t.staleness_clamped,
+                      "dropouts": stats_t.dropouts},
+    }
+    tr.invalidate_stream()
+    log(f"async_trace: top1 {acc_t:.4f}  {dt_t*1e3:.1f} ms/commit  "
+        f"virtual {vtotal_t/commits:.2f}/commit  "
+        f"dropouts {stats_t.dropouts}")
 
     # -- the verdict -----------------------------------------------------
     s, a = out["modes"]["sync"], out["modes"]["async"]

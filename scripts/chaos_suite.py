@@ -216,6 +216,241 @@ def run_suite(rounds: int = 20, smoke: bool = False, tol_points: float = 5.0,
     return report
 
 
+def run_availability_matrix(rounds: int = 12, smoke: bool = False,
+                            seed: int = 0, out_path: str = None) -> dict:
+    """The deployment-realism drill (docs/robustness.md §7) →
+    AVAIL_AB.json. Four legs:
+
+    * ``default_bitwise`` — the async scheduler under the ``default``
+      availability model must reproduce the RAW legacy straggler-knob
+      fold chain bitwise (recomputed inline here, independently of
+      `robustness/availability.py`), so the model refactor cannot have
+      moved a single draw.
+    * ``trace_replay`` — the armed sync lifecycle (trace model,
+      over-selection, deadline, quorum) run twice from one seed:
+      per-round server-param sha256 fingerprints identical, lifecycle
+      counters active, round program traced exactly once.
+    * ``degrade_vs_abort`` — at 95% dropout under a 0.9 quorum the
+      ``degrade`` action completes EVERY round (degraded, never
+      wedged — a naive deadline abort would stall the run), while the
+      ``abort`` action escalates into the supervisor's reseeded
+      retry → skip-with-cause path.
+    * ``async_dropout`` — the async commit loop under trace-model
+      dropouts: arrivals discarded + re-dispatched, commit sequence
+      deterministic under replay.
+    """
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import hashlib
+
+    import jax
+    import numpy as np
+
+    from fedtorch_tpu.algorithms import make_algorithm
+    from fedtorch_tpu.config import (
+        DataConfig, ExperimentConfig, FaultConfig, FederatedConfig,
+        ModelConfig, OptimConfig, TrainConfig,
+    )
+    from fedtorch_tpu.data import build_federated_data
+    from fedtorch_tpu.models import define_model
+    from fedtorch_tpu.parallel import FederatedTrainer
+    from fedtorch_tpu.robustness import RoundSupervisor
+    from fedtorch_tpu.utils.tracing import RecompilationSentinel
+
+    C = 8 if smoke else 16
+    B = 16 if smoke else 32
+    rounds = max(rounds, 6)
+    t0 = time.time()
+    report = {"rounds": rounds, "clients": C, "seed": seed, "legs": {}}
+
+    def fingerprint(tree) -> str:
+        h = hashlib.sha256()
+        for leaf in jax.tree.leaves(tree):
+            h.update(np.asarray(leaf).tobytes())
+        return h.hexdigest()[:16]
+
+    def make_cfg(fault: FaultConfig, sync_mode: str = "sync",
+                 num_comms: int = None):
+        return ExperimentConfig(
+            data=DataConfig(dataset="synthetic", synthetic_dim=30,
+                            batch_size=B, synthetic_alpha=0.5,
+                            synthetic_beta=0.5),
+            federated=FederatedConfig(
+                federated=True, num_clients=C,
+                num_comms=num_comms or rounds,
+                online_client_rate=0.5, algorithm="fedavg",
+                sync_type="local_step", sync_mode=sync_mode),
+            model=ModelConfig(arch="logistic_regression"),
+            optim=OptimConfig(lr=0.5, weight_decay=0.0),
+            train=TrainConfig(local_step=3),
+            fault=fault,
+        ).finalize()
+
+    # -- leg 1: default model bitwise vs the raw legacy fold chain ------
+    from fedtorch_tpu.async_plane.scheduler import AsyncSchedule
+    from fedtorch_tpu.robustness.availability import LEGACY_DELAY_SALT
+
+    rate, frac = 0.4, 0.1
+    # lint: disable=FTL001 — offline harness setup, raw key bytes
+    kd = np.asarray(jax.random.key_data(jax.random.key(seed)))
+    impl = jax.random.key_impl(jax.random.key(seed))
+
+    def make_sched():
+        return AsyncSchedule(kd, impl, num_clients=C, concurrency=4,
+                             buffer_size=2, ring_size=4,
+                             straggler_rate=rate,
+                             straggler_step_frac=frac)
+
+    sched = make_sched()
+    # dispatch 0's delay sits in the event heap as its finish time
+    # (dispatched at now=0), before any commit pops it
+    d0 = next(t for t, did, *_ in sched._heap if did == 0)
+    # recompute it by hand off the RAW legacy chain — u = uniform(
+    # fold(fold(key, SALT), dispatch_id), (2,)) on the cpu backend,
+    # then the historical host-f64 tail math
+    with jax.default_device(jax.local_devices(backend="cpu")[0]):
+        k = jax.random.fold_in(jax.random.key(seed), LEGACY_DELAY_SALT)
+        # lint: disable=FTL001 — the sync IS the measurement here
+        u = np.asarray(jax.random.uniform(jax.random.fold_in(k, 0),
+                                          (2,)), np.float64)
+    base = 1.0 + 0.25 * u[1]
+    want = base * (1.0 / frac) if u[0] < rate else base
+
+    def commit_seq(s):
+        return [(cm.commit, cm.idx.tolist(), cm.version.tolist(),
+                 cm.arrival_times.tolist()) for cm in
+                (s.next_commit() for _ in range(6))]
+
+    seq = commit_seq(sched)
+    seq2 = commit_seq(make_sched())
+    # lint: disable=FTL001 — report scalars for the JSON artifact
+    want_f, d0_f = float(want), float(d0)
+    report["legs"]["default_bitwise"] = {
+        "legacy_d0_recomputed": want_f,
+        "scheduler_d0": d0_f,
+        "d0_bitwise_match": want_f == d0_f,
+        "replay_identical": seq == seq2,
+        "commit_sequence_len": len(seq),
+    }
+    assert d0 == want, (
+        f"default model moved the legacy delay chain: scheduler drew "
+        f"{d0!r}, raw fold chain gives {want!r}")
+    assert seq == seq2, "default-model commit sequence not replayable"
+
+    # -- leg 2: armed sync lifecycle, bitwise replay + trace-once -------
+    armed = FaultConfig(avail_model="trace", avail_dropout_rate=0.3,
+                        avail_diurnal_period=8, over_select_frac=1.5,
+                        avail_quorum_frac=0.5)
+
+    def sync_run(fault, supervise=False, causes=None):
+        cfg = make_cfg(fault)
+        data = build_federated_data(cfg)
+        model = define_model(cfg, batch_size=B)
+        t = FederatedTrainer(cfg, model, make_algorithm(cfg), data.train)
+        server, clients = t.init_state(jax.random.key(seed))
+        run = t.run_round
+        sup = None
+        if supervise:
+            sup = RoundSupervisor(
+                t, sleep_fn=lambda s: None,
+                on_round_skipped=(lambda r, c: causes.append(c))
+                if causes is not None else None)
+            run = sup.run_round
+        fps, counters = [], {"avail_dropped": 0.0, "deadline_missed": 0.0,
+                             "quorum_degraded": 0.0}
+        server, clients, m = run(server, clients)
+        with RecompilationSentinel() as sentinel:
+            for _ in range(cfg.federated.num_comms - 1):
+                server, clients, m = run(server, clients)
+                for key_ in counters:
+                    counters[key_] += float(getattr(m, key_))
+                fps.append(fingerprint(server.params))
+        retraces = sum(sentinel.counts.values())
+        return fps, counters, retraces, sup, server
+
+    fps_a, counters, retraces, _, _ = sync_run(armed)
+    fps_b, _, _, _, _ = sync_run(armed)
+    report["legs"]["trace_replay"] = {
+        "fingerprints_identical": fps_a == fps_b,
+        "avail_dropped": int(counters["avail_dropped"]),
+        "deadline_missed": int(counters["deadline_missed"]),
+        "retraces": retraces,
+    }
+    assert fps_a == fps_b, \
+        "armed trace-model trajectories not seeded-replayable"
+    assert counters["avail_dropped"] + counters["deadline_missed"] > 0, \
+        "armed lifecycle injected nothing"
+    assert retraces == 0, (
+        f"armed round program retraced {retraces}x — over-selection/"
+        "deadline masking broke trace-once")
+
+    # -- leg 3: sub-quorum degrade completes; abort escalates -----------
+    heavy = dict(avail_model="trace", avail_dropout_rate=0.95,
+                 avail_diurnal_period=4, over_select_frac=1.5,
+                 avail_quorum_frac=0.9)
+    _, deg_counters, _, _, deg_server = sync_run(FaultConfig(**heavy))
+    deg_rounds = int(jax.device_get(deg_server.round))
+    causes = []
+    _, _, _, sup, ab_server = sync_run(
+        FaultConfig(supervisor=True, max_retries=1, backoff_base_s=0.0,
+                    avail_quorum_action="abort", **heavy),
+        supervise=True, causes=causes)
+    ab_rounds = int(jax.device_get(ab_server.round))
+    report["legs"]["degrade_vs_abort"] = {
+        "degrade_rounds_completed": deg_rounds,
+        "degraded_rounds": int(deg_counters["quorum_degraded"]),
+        "abort_rounds_completed": ab_rounds,
+        "abort_skipped_quorum": sup.stats.skipped_quorum,
+        "abort_skip_causes": sorted(set(causes)),
+    }
+    assert deg_rounds == rounds, (
+        f"degrade leg wedged at round {deg_rounds}/{rounds} — "
+        "sub-quorum rounds must complete degraded")
+    assert deg_counters["quorum_degraded"] > 0, \
+        "degrade leg never went sub-quorum at 95% dropout"
+    assert ab_rounds == rounds, "abort leg wedged the round counter"
+    assert sup.stats.skipped_quorum > 0 and causes, \
+        "abort leg never escalated a sub-quorum round"
+    assert set(causes) == {"quorum"}, f"unexpected skip causes {causes}"
+
+    # -- leg 4: async trace-model dropouts, deterministic ---------------
+    def async_run():
+        cfg = make_cfg(FaultConfig(avail_model="trace",
+                                   avail_dropout_rate=0.3,
+                                   **straggler_heavy_fault()),
+                       sync_mode="async", num_comms=rounds)
+        data = build_federated_data(cfg)
+        model = define_model(cfg, batch_size=B)
+        from fedtorch_tpu.async_plane import AsyncFederatedTrainer
+        t = AsyncFederatedTrainer(cfg, model, make_algorithm(cfg),
+                                  data.train)
+        server, clients = t.init_state(jax.random.key(seed))
+        for _ in range(rounds):
+            server, clients, m = t.run_round(server, clients)
+        st = t.schedule_stats  # grab before invalidate clears the sim
+        t.invalidate_stream()
+        return fingerprint(server.params), st
+
+    fp1, st1 = async_run()
+    fp2, st2 = async_run()
+    report["legs"]["async_dropout"] = {
+        "fingerprint_identical": fp1 == fp2,
+        "dropouts": st1.dropouts,
+    }
+    assert fp1 == fp2, "async trace-model run not seeded-replayable"
+    assert st1.dropouts > 0, "async availability model dropped nothing"
+    assert st1.dropouts == st2.dropouts, \
+        "async dropout count not deterministic"
+
+    report["wall_seconds"] = round(time.time() - t0, 1)
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+        log(f"wrote {out_path}")
+    return report
+
+
 def run_builder_matrix(rounds: int = 8, smoke: bool = False,
                        seed: int = 0, out_path: str = None) -> dict:
     """Round-program-builder smoke (ISSUE 11): three representative
@@ -1117,6 +1352,16 @@ def main():
                          "program; writes --builder-out")
     ap.add_argument("--builder-out", default="BUILDER_MATRIX.json",
                     help="output path for the builder-matrix report")
+    ap.add_argument("--availability-matrix", action="store_true",
+                    help="run the deployment-realism drill instead: "
+                         "default-model draws bitwise vs the raw "
+                         "legacy straggler chain, armed trace-model "
+                         "lifecycle seeded-replayable + trace-once, "
+                         "sub-quorum degrade-vs-abort, async "
+                         "trace-model dropouts deterministic; writes "
+                         "--avail-out (docs/robustness.md §7)")
+    ap.add_argument("--avail-out", default="AVAIL_AB.json",
+                    help="output path for the availability report")
     ap.add_argument("--ledger-attack", action="store_true",
                     help="run the ledger-separation drill instead: a "
                          "real CLI run per robust rule with the PR 9 "
@@ -1129,6 +1374,13 @@ def main():
     ap.add_argument("--ledger-out", default="COHORT_AB.json",
                     help="output path for the ledger-attack report")
     args = ap.parse_args()
+    if args.availability_matrix:
+        report = run_availability_matrix(rounds=args.rounds,
+                                         smoke=args.smoke,
+                                         seed=args.seed,
+                                         out_path=args.avail_out)
+        print(json.dumps(report), flush=True)
+        return
     if args.ledger_attack:
         report = run_ledger_attack(rounds=args.rounds,
                                    smoke=args.smoke, seed=args.seed,

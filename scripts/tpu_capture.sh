@@ -26,6 +26,15 @@
 #                         sign_flip must break plain mean by >5 pts
 #                         while >=1 robust rule holds within 5 —
 #                         docs/robustness.md threat-model table)
+#   avail            scripts/chaos_suite.py --availability-matrix
+#                        -> AVAIL_AB.json (deployment-realism drill:
+#                         default-model arrivals bitwise vs the raw
+#                         legacy straggler chain, armed trace-model
+#                         lifecycle seeded-replayable + trace-once,
+#                         sub-quorum degrade completes where abort
+#                         escalates into the supervisor, async
+#                         trace-model dropouts deterministic —
+#                         docs/robustness.md "Deployment realism")
 #   builder-matrix   scripts/chaos_suite.py --builder-matrix
 #                        -> BUILDER_MATRIX.json (round-program-builder
 #                         smoke: scanned device, scanned streamed and
@@ -113,9 +122,9 @@ TRIES="${TPU_CAPTURE_WAIT_TRIES:-90}"   # ~6 h of patience by default
 # the relay wedges mid-list
 # audit rides early: it is seconds of abstract lowering and proves the
 # program invariants on the real backend before the long benches run
-DEFAULT_STEPS="audit mfu stream builder-matrix async attack host-chaos \
-cohort telemetry compare bench-streaming bench-dispatch bench-unroll \
-bench zoo pallas flash-train vmap baseline"
+DEFAULT_STEPS="audit mfu stream builder-matrix avail async attack \
+host-chaos cohort telemetry compare bench-streaming bench-dispatch \
+bench-unroll bench zoo pallas flash-train vmap baseline"
 STEPS="${*:-$DEFAULT_STEPS}"
 
 echo "[tpu_capture] waiting for the relay (up to ${TRIES}x120s probes)"
@@ -140,6 +149,9 @@ for step in $STEPS; do
         builder-matrix) run python scripts/chaos_suite.py \
                             --builder-matrix --rounds 8 \
                             --builder-out BUILDER_MATRIX.json ;;
+        avail)          run python scripts/chaos_suite.py \
+                            --availability-matrix --rounds 12 \
+                            --avail-out AVAIL_AB.json ;;
         host-chaos)     run python scripts/chaos_suite.py \
                             --host-fault-matrix --rounds 12 \
                             --host-out HOST_CHAOS_AB.json ;;

@@ -122,3 +122,25 @@ def test_straggler_heavy_async_within_tolerance():
         assert entry["gap_points"] <= 5.0
         assert entry["async_stragglers"] > 0
         assert entry["commit_retraces"] == 0
+
+
+@pytest.mark.slow
+def test_availability_matrix_acceptance():
+    """ISSUE 16 acceptance: the default model reproduces the raw
+    legacy fold chain bitwise; the armed trace-model lifecycle is
+    seeded-replayable and trace-once; sub-quorum rounds complete
+    degraded under 'degrade' while 'abort' escalates into the
+    supervisor with cause='quorum'; async trace-model dropouts are
+    deterministic."""
+    from chaos_suite import run_availability_matrix
+    report = run_availability_matrix(rounds=6, smoke=True)
+    legs = report["legs"]
+    assert legs["default_bitwise"]["d0_bitwise_match"]
+    assert legs["default_bitwise"]["replay_identical"]
+    assert legs["trace_replay"]["fingerprints_identical"]
+    assert legs["trace_replay"]["retraces"] == 0
+    assert legs["degrade_vs_abort"]["degrade_rounds_completed"] == 6
+    assert legs["degrade_vs_abort"]["degraded_rounds"] > 0
+    assert legs["degrade_vs_abort"]["abort_skip_causes"] == ["quorum"]
+    assert legs["async_dropout"]["fingerprint_identical"]
+    assert legs["async_dropout"]["dropouts"] > 0
