@@ -403,11 +403,16 @@ class StreamFeedProducer:
         with depth 0 means the producer is the round clock — the
         input-stall signal tf.data's instrumentation exists to surface
         (Murray et al. 2021)."""
+        # monotone float accumulators, producer-written/consumer-read:
+        # each is one GIL-atomic store per round, and a momentarily
+        # stale gauge in a once-per-round telemetry snapshot is
+        # harmless — a lock here would serialize the producer's hot
+        # loop against the round-row emit for no observable gain
         return {
             "stream_depth": float(self._prefetcher.depth()),
             "stream_wait_s": self.wait_s,
-            "stream_gather_s": self.gather_s,
-            "stream_h2d_s": self.h2d_s,
+            "stream_gather_s": self.gather_s,  # lint: disable=FTH003 — GIL-atomic monotone gauges; staleness is bounded by one round
+            "stream_h2d_s": self.h2d_s,  # lint: disable=FTH003 — GIL-atomic monotone gauges; staleness is bounded by one round
             "stream_produced": float(self.rounds_produced),
         }
 

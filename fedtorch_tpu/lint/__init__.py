@@ -8,6 +8,9 @@ values — none crash, all destroy TPU throughput or determinism.  The
 static rules here approximate what the runtime recompilation sentinel
 (``fedtorch_tpu.utils.tracing.RecompilationSentinel``) measures
 dynamically; the two gates ship together (scripts/lint_suite.py).
+The host-plane concurrency audit (``lint.concurrency_audit``, FTH
+rules) is the same pairing for lock/thread hazards — its runtime half
+is ``fedtorch_tpu.utils.lock_sentinel.LockOrderSentinel``.
 
 Stdlib-only: importing this package must never pull in jax, so the
 gate runs in any CI lane. (The program-level audit —
@@ -19,6 +22,10 @@ is pure stdlib.)
 from fedtorch_tpu.lint.analyzer import (  # noqa: F401
     ModuleAnalysis, analyze_paths, analyze_source,
 )
+from fedtorch_tpu.lint.concurrency_audit import (  # noqa: F401
+    analyze_concurrency_source, audit_concurrency_paths,
+    concurrency_gate, split_hard_findings,
+)
 from fedtorch_tpu.lint.findings import (  # noqa: F401
     Finding, diff_against_baseline, load_baseline, save_baseline,
 )
@@ -26,5 +33,5 @@ from fedtorch_tpu.lint.registry_audit import (  # noqa: F401
     audit_registries,
 )
 from fedtorch_tpu.lint.rules import (  # noqa: F401
-    ALL_RULES, PROGRAM_RULES, REGISTRY_RULES, RULES,
+    ALL_RULES, CONCURRENCY_RULES, PROGRAM_RULES, REGISTRY_RULES, RULES,
 )

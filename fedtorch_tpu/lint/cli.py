@@ -11,15 +11,22 @@ gate ``scripts/lint_suite.py`` and ``tests/test_lint_suite.py`` wrap.
     python -m fedtorch_tpu.lint --explain       # rule catalog
     python -m fedtorch_tpu.lint path/to/file.py # specific targets
 
+``--concurrency`` runs the host-plane concurrency audit (FTH rules,
+``concurrency_audit.py``) instead: the static lock-acquisition graph
+and thread-escape map over the package + scripts, gated against
+``lint/concurrency_baseline.json`` — except FTH001 lock-order cycles,
+which are hard errors and bypass the baseline entirely.
+
 ``--audit`` (also reachable as ``fedtorch-tpu audit``) runs the OTHER
-two halves instead of the AST gate: the registry-drift checker
-(``registry_audit``, stdlib-only) and the program-level audit
-(``program_audit`` — abstractly lowers every legal round-program
-builder cell on the active backend and checks the HLO/jaxpr; needs
-jax). ``--registry-only`` skips the lowering half for jax-free lanes;
-``--write-baseline`` under ``--audit`` re-pins
-``lint/program_baseline.json``; ``--out FILE`` writes the audit
-report document (the ``audit`` step of scripts/tpu_capture.sh).
+halves instead of the AST gate: the registry-drift checker
+(``registry_audit``, stdlib-only), the concurrency gate (also
+stdlib), and the program-level audit (``program_audit`` — abstractly
+lowers every legal round-program builder cell on the active backend
+and checks the HLO/jaxpr; needs jax). ``--registry-only`` skips the
+lowering half for jax-free lanes; ``--write-baseline`` under
+``--audit`` re-pins ``lint/program_baseline.json``; ``--out FILE``
+writes the audit report document (the ``audit`` step of
+scripts/tpu_capture.sh).
 """
 from __future__ import annotations
 
@@ -72,6 +79,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--audit", action="store_true",
                    help="run the program-level + registry-drift audit "
                         "(FTP/FTC rules) instead of the AST gate")
+    p.add_argument("--concurrency", action="store_true",
+                   help="run the host-plane concurrency audit (FTH "
+                        "rules) instead of the tracing AST gate")
     p.add_argument("--registry-only", action="store_true",
                    help="with --audit: only the stdlib registry-drift "
                         "half (no jax, no program lowering)")
@@ -81,6 +91,63 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def run_concurrency(args) -> int:
+    """The ``fedtorch-tpu lint --concurrency`` gate: FTH findings over
+    the package + scripts, diffed against
+    ``lint/concurrency_baseline.json``. FTH001 lock-order cycles are
+    HARD errors: they bypass the baseline (and ``--write-baseline``
+    refuses to pin them)."""
+    from fedtorch_tpu.lint.concurrency_audit import (
+        CONCURRENCY_BASELINE_REL, CONCURRENCY_TARGETS,
+        audit_concurrency_paths, split_hard_findings,
+    )
+
+    root = args.root or repo_root()
+    targets = args.targets or list(CONCURRENCY_TARGETS)
+    baseline_path = args.baseline if args.baseline != DEFAULT_BASELINE \
+        else os.path.join(root, CONCURRENCY_BASELINE_REL)
+    findings = audit_concurrency_paths(root, targets)
+    hard, soft = split_hard_findings(findings)
+
+    if args.write_baseline:
+        save_baseline(baseline_path, soft)
+        print(f"wrote {len(soft)} finding(s) to {baseline_path}")
+        for f in hard:
+            print(f.render())
+        if hard:
+            print(f"fedtorch_tpu.lint --concurrency: {len(hard)} "
+                  "FTH001 cycle(s) NOT baselined — hard errors")
+            return 1
+        return 0
+
+    if args.all:
+        new, matched = findings, 0
+    else:
+        new_soft, matched = diff_against_baseline(
+            soft, load_baseline(baseline_path))
+        new = sorted(hard + new_soft,
+                     key=lambda f: (f.path, f.line, f.rule))
+
+    report = {"total": len(findings), "baselined": matched,
+              "hard_errors": len(hard),
+              "new": [f.__dict__ for f in new]}
+    if args.format == "json":
+        print(json.dumps(report, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        label = "finding(s)" if args.all else "NEW finding(s)"
+        print(f"fedtorch_tpu.lint --concurrency: {len(new)} {label} "
+              f"({len(findings)} total, {matched} baselined, "
+              f"{len(hard)} hard)")
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(report, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        print(f"concurrency report written to {args.out}")
+    return 1 if new else 0
+
+
 def run_audit(args) -> int:
     """The ``fedtorch-tpu audit`` gate: registry drift (stdlib) +
     program-level HLO/jaxpr checks over every builder cell."""
@@ -88,10 +155,17 @@ def run_audit(args) -> int:
 
     from fedtorch_tpu.lint.registry_audit import audit_registries
 
+    from fedtorch_tpu.lint.concurrency_audit import concurrency_gate
+
     root = args.root or repo_root()
     reg_findings = audit_registries(root)
-    report = {"registry_findings": len(reg_findings)}
-    findings = list(reg_findings)
+    # the concurrency gate is stdlib like the registry half: FTH001
+    # hard errors + soft findings not in concurrency_baseline.json
+    conc_new, conc_total = concurrency_gate(root)
+    report = {"registry_findings": len(reg_findings),
+              "concurrency_findings": len(conc_new),
+              "concurrency_total": conc_total}
+    findings = list(reg_findings) + conc_new
     if not args.registry_only:
         from fedtorch_tpu.lint.program_audit import (
             PROGRAM_BASELINE, audit_programs,
@@ -113,8 +187,9 @@ def run_audit(args) -> int:
             print(f.render())
         print(f"fedtorch-tpu audit: {len(findings)} NEW finding(s) "
               f"({len(reg_findings)} registry, "
-              f"{len(findings) - len(reg_findings)} program; "
-              f"wall {report.get('wall_s', 0)}s)")
+              f"{len(conc_new)} concurrency, "
+              f"{len(findings) - len(reg_findings) - len(conc_new)} "
+              f"program; wall {report.get('wall_s', 0)}s)")
     if args.out:
         report_doc = dict(report)
         report_doc["findings"] = [f.__dict__ for f in findings]
@@ -132,6 +207,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
     if args.audit:
         return run_audit(args)
+    if args.concurrency:
+        return run_concurrency(args)
     root = args.root or repo_root()
     targets = args.targets or list(DEFAULT_TARGETS)
     findings = analyze_paths(root, targets)

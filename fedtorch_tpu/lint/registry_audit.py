@@ -503,6 +503,41 @@ def check_builder_cells(root: str) -> List[Finding]:
     return diff_builder_cells(axis_tuples(rp), illegal_cells(test), test)
 
 
+# -- FTC006: lint-rule docs drift ----------------------------------------
+
+_RULE_ID_RE = re.compile(r"`([A-Z]{3}\d{3})`")
+
+
+def documented_rule_ids(doc_text: str) -> Set[str]:
+    """Backticked rule ids appearing anywhere in the doc (the pinned
+    markdown_table renders each id as `FTXnnn`)."""
+    return set(_RULE_ID_RE.findall(doc_text))
+
+
+def diff_rule_docs(rule_ids: Iterable[str],
+                   documented: Set[str]) -> List[Finding]:
+    out: List[Finding] = []
+    for rid in sorted(set(rule_ids) - documented):
+        out.append(_finding(
+            "docs/static_analysis.md", 0, "FTC006",
+            f"rule {rid} is registered in lint/rules.py but absent "
+            "from the docs/static_analysis.md rule tables", rid))
+    return out
+
+
+def check_rule_docs(root: str) -> List[Finding]:
+    """FTH (and the table-rendered FTP/FTC) ids must appear in
+    docs/static_analysis.md. FTL ids are documented as unbackticked
+    section headings, so only the table-pinned families are diffed."""
+    from fedtorch_tpu.lint.rules import (
+        CONCURRENCY_RULES, PROGRAM_RULES, REGISTRY_RULES,
+    )
+    doc = _read(root, "docs/static_analysis.md")
+    ids = (list(CONCURRENCY_RULES) + list(PROGRAM_RULES)
+           + list(REGISTRY_RULES))
+    return diff_rule_docs(ids, documented_rule_ids(doc))
+
+
 # -- the whole registry audit --------------------------------------------
 
 def audit_registries(root: str) -> List[Finding]:
@@ -517,4 +552,5 @@ def audit_registries(root: str) -> List[Finding]:
     findings += check_seams(root)
     findings += check_config_cli(root)
     findings += check_builder_cells(root)
+    findings += check_rule_docs(root)
     return sorted(findings, key=lambda f: (f.rule, f.path, f.message))

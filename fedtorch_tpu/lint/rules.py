@@ -1,10 +1,12 @@
 """Rule registry: ids, rationale, and fix hints.
 
 The detection logic lives in ``analyzer.py`` (FTL: source-level AST
-hazards), ``program_audit.py`` (FTP: checks over the LOWERED
-jaxpr/HLO of every round-program builder cell) and
-``registry_audit.py`` (FTC: drift between hand-maintained registries
-and their emit sites/docs); this module is the single place a rule's
+hazards), ``concurrency_audit.py`` (FTH: host-plane lock/thread
+hazards over a static lock-acquisition graph), ``program_audit.py``
+(FTP: checks over the LOWERED jaxpr/HLO of every round-program
+builder cell) and ``registry_audit.py`` (FTC: drift between
+hand-maintained registries and their emit sites/docs); this module
+is the single place a rule's
 id, one-line description, and default fix hint are defined, so the
 CLI ``--explain`` output, the docs tables (rendered by
 :func:`markdown_table`, pinned against docs/static_analysis.md by
@@ -112,7 +114,56 @@ PROGRAM_RULES: Dict[str, Rule] = {r.rule_id: r for r in [
          "(memory_analysis temp/argument bytes name the side)"),
 ]}
 
-# Registry-drift rules: the five hand-maintained catalogs and the
+# Host-plane concurrency rules: checked by lint/concurrency_audit.py
+# over a static lock-acquisition graph + thread-escape map of each
+# module. The host plane replaces the reference's one-process-per-
+# client C10D layer with 7+ threads in one process, and every
+# concurrency bug so far (the PR 10 injector self-deadlock, the
+# mid-flush JsonlWriter buffer mutation, the checkpointer's racing
+# .tmp names) was found by hand — these rules gate the hazard class.
+# FTH001 findings are HARD errors: a lock-order cycle cannot be
+# baselined, only refactored away.
+CONCURRENCY_RULES: Dict[str, Rule] = {r.rule_id: r for r in [
+    Rule("FTH001",
+         "lock-order cycle across host-plane call paths "
+         "(with-blocks and bare .acquire())",
+         "impose one documented acquisition order and release before "
+         "calling into the other subsystem — cycles are hard errors "
+         "and cannot be baselined"),
+    Rule("FTH002",
+         "telemetry/health emit reachable while holding a lock "
+         "(the PR 10 injector self-deadlock class)",
+         "snapshot the announce fields inside the with-block and call "
+         "telemetry.event / faults.check after releasing — an emit "
+         "can re-enter the writer whose lock is held"),
+    Rule("FTH003",
+         "attribute written on a spawned thread and read from "
+         "main-thread methods with no common lock",
+         "take the writer's lock on the read side too, or justify the "
+         "GIL-atomic single-store with a suppression naming the "
+         "invariant"),
+    Rule("FTH004",
+         "unbounded blocking (queue get/put, join, wait, acquire "
+         "without timeout) while holding a lock or inside a daemon "
+         "worker",
+         "pass a timeout and re-check the stop flag in a loop — a "
+         "bounded wait keeps close() and the stall watchdog able to "
+         "make progress"),
+    Rule("FTH005",
+         "thread spawned without a stable name= or daemon thread "
+         "with no close/join path",
+         "name every thread (watchdog stack dumps, span lanes, and "
+         "sentinel reports key on it) and join daemon workers in a "
+         "close() with a timeout"),
+    Rule("FTH006",
+         "run-dir artifact written without the write-tmp-then-"
+         "os.replace protocol",
+         "write to a tmp sibling and os.replace into place (health/"
+         "ledger/checkpoint writers are the reference); append-mode "
+         "jsonl is the other sanctioned shape"),
+]}
+
+# Registry-drift rules: the hand-maintained catalogs and the
 # sources they must stay in lockstep with (lint/registry_audit.py).
 REGISTRY_RULES: Dict[str, Rule] = {r.rule_id: r for r in [
     Rule("FTC001",
@@ -145,9 +196,16 @@ REGISTRY_RULES: Dict[str, Rule] = {r.rule_id: r for r in [
          "a new axis value/illegal cell needs the axis tuple, an "
          "entry in tests/test_round_builder.py's matrix, and a "
          "refusal-message snapshot test"),
+    Rule("FTC006",
+         "lint-rule docs drift: registered FTH rule ids absent from "
+         "the docs/static_analysis.md rule tables",
+         "regenerate the pinned table from lint/rules.py "
+         "markdown_table — the docs tables are generated, not "
+         "hand-maintained"),
 ]}
 
-ALL_RULES: Dict[str, Rule] = {**RULES, **PROGRAM_RULES, **REGISTRY_RULES}
+ALL_RULES: Dict[str, Rule] = {
+    **RULES, **CONCURRENCY_RULES, **PROGRAM_RULES, **REGISTRY_RULES}
 
 
 def hint_for(rule_id: str) -> str:
@@ -169,6 +227,8 @@ def explain() -> str:
     lines = ["fedtorch_tpu.lint rules (details: docs/static_analysis.md)",
              ""]
     for title, family in (("source (AST analyzer)", RULES),
+                          ("host-plane concurrency (fedtorch-tpu "
+                           "lint --concurrency)", CONCURRENCY_RULES),
                           ("lowered program (fedtorch-tpu audit)",
                            PROGRAM_RULES),
                           ("registry drift (fedtorch-tpu audit)",

@@ -265,7 +265,11 @@ class HostPrefetcher:
                 item = self._q.get(timeout=min(
                     0.2, max(deadline - time.monotonic(), 0.01)))
             except queue.Empty:
-                if self._error is not None:
+                # GIL-atomic single store: the worker writes _error
+                # exactly once (then exits) and this side only reads —
+                # a lock would add a queue-poll-rate hot path for a
+                # once-per-lifetime publication
+                if self._error is not None:  # lint: disable=FTH003 — worker's one write precedes its exit; reference-assignment is atomic
                     raise RuntimeError(
                         f"{self.name!r} producer thread died: "
                         f"{self._error!r}") from self._error

@@ -32,7 +32,6 @@ from __future__ import annotations
 
 import errno
 import hashlib
-import threading
 import time
 from typing import Dict, Optional
 
@@ -72,7 +71,7 @@ class HostFaultInjector:
         self.checks: Dict[str, int] = {s: 0 for s in seams}
         self.fires: Dict[str, int] = {s: 0 for s in seams}
         self._announced: set = set()
-        self._lock = threading.Lock()
+        self._lock = _tel_faults.new_lock("HostFaultInjector._lock")
 
     @classmethod
     def from_config(cls, fault) -> Optional["HostFaultInjector"]:

@@ -29,7 +29,6 @@ from __future__ import annotations
 
 import dataclasses
 import sys
-import threading
 import time
 from typing import Callable, Dict, Optional, Tuple
 
@@ -71,7 +70,7 @@ class HostRecovery:
         self.recovered: Dict[str, int] = {}
         self.degraded: set = set()
         self._recovered_announced: set = set()
-        self._lock = threading.Lock()
+        self._lock = _tel_faults.new_lock("HostRecovery._lock")
 
     # -- lifecycle ------------------------------------------------------
     def install(self) -> "HostRecovery":

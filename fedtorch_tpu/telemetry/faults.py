@@ -16,13 +16,22 @@ which compile to a None-test when nothing is armed.
 * :func:`note_degraded` — called once when a writer gives up (too many
   consecutive failures), so the run's degraded-seam set and the
   ``health.json`` ``degraded`` intent see it.
+* :func:`new_lock` — the lock factory the host-plane subsystems create
+  their mutexes through. Unarmed it returns a plain
+  ``threading.Lock`` (zero overhead); the runtime lock-order sentinel
+  (``fedtorch_tpu.utils.lock_sentinel`` — which lives on the jax side
+  and therefore cannot be imported from here) registers a factory hook
+  while armed, so every lock created inside its scope is instrumented
+  with a stable name and per-thread acquisition-order recording.
 """
 from __future__ import annotations
 
+import threading
 from typing import Callable, Optional
 
 _check_hook: Optional[Callable[[str], None]] = None
 _degrade_sink: Optional[Callable[[str], None]] = None
+_lock_hook: Optional[Callable[[str], object]] = None
 
 
 def set_check_hook(fn: Optional[Callable[[str], None]]) -> None:
@@ -49,3 +58,23 @@ def note_degraded(seam: str) -> None:
     """Report that the subsystem owning ``seam`` degraded itself."""
     if _degrade_sink is not None:
         _degrade_sink(seam)
+
+
+def set_lock_hook(fn: Optional[Callable[[str], object]]):
+    """Install (or clear, with None) the named-lock factory hook.
+    Returns the previously installed hook so a scoped sentinel can
+    chain/restore it on exit."""
+    global _lock_hook
+    prev = _lock_hook
+    _lock_hook = fn
+    return prev
+
+
+def new_lock(name: str):
+    """A mutex for the host-plane subsystem that names it. Plain
+    ``threading.Lock`` unless a lock-order sentinel armed the factory
+    hook — then an instrumented wrapper recording acquisition order
+    under ``name``."""
+    if _lock_hook is not None:
+        return _lock_hook(name)
+    return threading.Lock()

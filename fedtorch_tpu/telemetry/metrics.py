@@ -17,9 +17,10 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 import time
 from typing import Dict, List, Optional
+
+from fedtorch_tpu.telemetry import faults as _tel_faults
 
 
 class JsonlWriter:
@@ -63,9 +64,12 @@ class JsonlWriter:
         # concurrent f.write calls can splice lines). The injection
         # check runs under NONE of them: its first-fire announce
         # re-enters this writer, and any held lock would self-deadlock.
-        self._mutex = threading.Lock()
-        self._open_lock = threading.Lock()
-        self._io_lock = threading.Lock()
+        # Created through the faults lock factory: the lock-order
+        # sentinel (utils/lock_sentinel.py) instruments them by name
+        # when armed; unarmed these are plain threading.Locks.
+        self._mutex = _tel_faults.new_lock("JsonlWriter._mutex")
+        self._open_lock = _tel_faults.new_lock("JsonlWriter._open_lock")
+        self._io_lock = _tel_faults.new_lock("JsonlWriter._io_lock")
         self._last_flush = time.monotonic()
         self._f = None
         self._header = {"schema": schema,
@@ -149,9 +153,13 @@ class JsonlWriter:
                 # a long outage must not grow host memory without bound
                 del self._buf[0]
                 self.dropped_rows += 1
+            # the flush decision reads the buffer length, so it
+            # belongs under the same mutex as the appends (FTH003
+            # half-discipline: a concurrent drain between the append
+            # and an unlocked read could skip the row-count trigger)
+            want_flush = flush or len(self._buf) >= self.flush_rows
         now = time.monotonic()
-        if (flush or len(self._buf) >= self.flush_rows
-                or now - self._last_flush >= self.flush_interval_s):
+        if want_flush or now - self._last_flush >= self.flush_interval_s:
             self.flush()
 
     def flush(self) -> None:

@@ -13,6 +13,9 @@ from fedtorch_tpu.utils.meters import (  # noqa: F401
 from fedtorch_tpu.utils.compile_cache import (  # noqa: F401
     enable_compile_cache, jit_cache_size,
 )
+from fedtorch_tpu.utils.lock_sentinel import (  # noqa: F401
+    LockOrderSentinel, active_sentinel,
+)
 from fedtorch_tpu.utils.platform import honor_platform_env  # noqa: F401
 from fedtorch_tpu.utils.tracing import (  # noqa: F401
     RecompilationSentinel, capture_round_trace, instrument_trace,

@@ -33,6 +33,7 @@ from flax import serialization
 
 from fedtorch_tpu import telemetry
 from fedtorch_tpu.config import ExperimentConfig
+from fedtorch_tpu.telemetry import faults as _tel_faults
 
 
 def get_checkpoint_folder_name(cfg: ExperimentConfig) -> str:
@@ -405,7 +406,10 @@ class AsyncCheckpointer:
         self._q: "queue.Queue" = queue.Queue(maxsize=1)
         self._closed = False
         # write-latency/queue gauges for the telemetry round row
-        # (docs/observability.md): host counters, read lock-free
+        # (docs/observability.md): written by the worker thread,
+        # snapshotted by stats()/save() on the caller thread — both
+        # sides under _gauges, never held across IO or an emit
+        self._gauges = _tel_faults.new_lock("AsyncCheckpointer._gauges")
         self.writes = 0
         self.last_write_s = 0.0
         self.total_write_s = 0.0
@@ -420,7 +424,10 @@ class AsyncCheckpointer:
 
     def _worker(self):
         while True:
-            job = self._q.get()
+            # the blocking get IS the worker's idle state: close()
+            # always lands the None sentinel (size-1 queue, drained
+            # first), so a timeout here would only add wakeup churn
+            job = self._q.get()  # lint: disable=FTH004 — close() enqueues the None sentinel; no lock held
             if job is None:
                 self._q.task_done()
                 return
@@ -429,12 +436,15 @@ class AsyncCheckpointer:
                 # job[4] is round_idx (the _write_checkpoint signature)
                 with telemetry.span("checkpoint.write", round=job[4]):
                     _write_checkpoint(*job)
-                self.writes += 1
+                with self._gauges:
+                    self.writes += 1
             except Exception as e:
                 self._note_degraded(job[4], e)
             finally:
-                self.last_write_s = time.perf_counter() - t0
-                self.total_write_s += self.last_write_s
+                dt = time.perf_counter() - t0
+                with self._gauges:
+                    self.last_write_s = dt
+                    self.total_write_s += dt
                 self._q.task_done()
 
     def _note_degraded(self, round_idx, exc) -> None:
@@ -442,9 +452,13 @@ class AsyncCheckpointer:
         to synchronous writes — never poison an unrelated later
         save()."""
         import sys
-        self.lost_writes += 1
-        first = not self.degraded
-        self.degraded = True
+        # flip the state under the gauges lock, emit AFTER releasing:
+        # both note_degraded and telemetry.event below can re-enter a
+        # writer (the FTH002/PR 10 class)
+        with self._gauges:
+            self.lost_writes += 1
+            first = not self.degraded
+            self.degraded = True
         print(f"AsyncCheckpointer: write for round {round_idx} lost "
               f"after retries ({exc!r}); degrading to synchronous "
               "checkpoint writes", file=sys.stderr, flush=True)
@@ -459,14 +473,15 @@ class AsyncCheckpointer:
         how many snapshots sit queued behind the worker (a rising
         queue depth means disk is slower than the eval cadence), and
         the degraded-mode pair."""
-        return {
-            "ckpt_queue_depth": float(self._q.qsize()),
-            "ckpt_writes": float(self.writes),
-            "ckpt_last_write_s": self.last_write_s,
-            "ckpt_total_write_s": self.total_write_s,
-            "ckpt_degraded": float(self.degraded),
-            "ckpt_lost_writes": float(self.lost_writes),
-        }
+        with self._gauges:
+            return {
+                "ckpt_queue_depth": float(self._q.qsize()),
+                "ckpt_writes": float(self.writes),
+                "ckpt_last_write_s": self.last_write_s,
+                "ckpt_total_write_s": self.total_write_s,
+                "ckpt_degraded": float(self.degraded),
+                "ckpt_lost_writes": float(self.lost_writes),
+            }
 
     def save(self, directory: str, server, clients,
              cfg: ExperimentConfig, best_prec1: float, is_best: bool,
@@ -483,7 +498,9 @@ class AsyncCheckpointer:
                _meta_for(cfg, round_idx, best_prec1), is_best,
                round_idx, save_all, save_some_rounds,
                cfg.checkpoint.keep_last_n)
-        if self.degraded:
+        with self._gauges:
+            degraded = self.degraded
+        if degraded:
             # synchronous fallback: the write happens HERE, so a
             # persistent disk fault raises at the save it actually
             # broke (honest attribution), and a recovered disk keeps
@@ -503,10 +520,13 @@ class AsyncCheckpointer:
                     # must name the seam either way
                     host_recovery.retry_io(
                         lambda: _write_checkpoint(*job), "ckpt.write")
-                self.writes += 1
+                with self._gauges:
+                    self.writes += 1
             finally:
-                self.last_write_s = time.perf_counter() - t0
-                self.total_write_s += self.last_write_s
+                dt = time.perf_counter() - t0
+                with self._gauges:
+                    self.last_write_s = dt
+                    self.total_write_s += dt
             return
         self._q.put(job)
 

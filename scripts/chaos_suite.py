@@ -1008,6 +1008,7 @@ def run_host_fault_matrix(rounds: int = 12, smoke: bool = False,
         TrainConfig,
     )
     from fedtorch_tpu.telemetry import iter_jsonl
+    from fedtorch_tpu.utils.lock_sentinel import LockOrderSentinel
     from fedtorch_tpu.utils.tracing import RecompilationSentinel
 
     seams = tuple(seams) if seams else HOST_FAULT_SEAMS + (
@@ -1059,9 +1060,15 @@ def run_host_fault_matrix(rounds: int = 12, smoke: bool = False,
                 jax.device_get(jax.tree.leaves(server.params))))
 
         cfg = cell_cfg(run_dir, fault, save_all)
-        with RecompilationSentinel() as sentinel:
+        # the lock-order sentinel rides every drill cell: injected
+        # faults exercise the writer/injector/recovery lock paths
+        # under contention, exactly where an ordering inversion or a
+        # re-entrant emit (the PR 10 self-deadlock) would surface
+        with RecompilationSentinel() as sentinel, \
+                LockOrderSentinel() as locks:
             results = run_experiment(cfg, round_callback=cb)
-        return fingerprints, results, run_dir, dict(sentinel.counts)
+        return (fingerprints, results, run_dir, dict(sentinel.counts),
+                locks.order_edges())
 
     def read_rows(run_dir):
         path = os.path.join(run_dir, "metrics.jsonl")
@@ -1076,12 +1083,14 @@ def run_host_fault_matrix(rounds: int = 12, smoke: bool = False,
         return [r for r in iter_jsonl(path) if "event" in r]
 
     log(f"host-fault matrix: baseline ({rounds} rounds, C={C})")
-    base_fps, base_res, base_dir, base_traces = one_run(
-        "baseline", FaultConfig())
+    base_fps, base_res, base_dir, base_traces, base_lock_edges = \
+        one_run("baseline", FaultConfig())
     assert len(base_fps) == rounds, "baseline did not complete"
 
     report = {"rounds": rounds, "clients": C, "rate": rate,
               "seed": seed, "baseline_traces": base_traces,
+              "baseline_lock_order": base_lock_edges,
+              "lock_order_violations": 0,
               "matrix": {}}
     t0 = time.time()
     for seam in seams:
@@ -1100,7 +1109,7 @@ def run_host_fault_matrix(rounds: int = 12, smoke: bool = False,
                                 host_fault_rate=rate,
                                 host_fault_seed=seed,
                                 host_retry_backoff_s=0.0)
-        fps, results, run_dir, traces = one_run(
+        fps, results, run_dir, traces, lock_edges = one_run(
             seam, fault, save_all=seam == "ckpt.torn")
 
         # run-survival + bitwise trajectory (the stream plane replays
@@ -1135,6 +1144,7 @@ def run_host_fault_matrix(rounds: int = 12, smoke: bool = False,
             "host_faults": fired, "host_retries": retries,
             "host_recovered": recovered, "host_degraded": degraded,
             "stream_rebuilds": rebuilds, "traces": traces,
+            "lock_order": lock_edges,
             "bitwise_identical": True,
             "events": sorted(set(names) - {"run.start", "run.end"}),
         }
@@ -1282,7 +1292,11 @@ def run_kill_drill(rounds: int = 150, ckpt_root: str = None) -> dict:
                         return
                     time.sleep(0.02)
 
-            threading.Thread(target=killer, daemon=True).start()
+            # daemon watcher scoped to the child process: it exits as
+            # soon as proc.poll() turns non-None, so there is no close
+            # path to join it from
+            threading.Thread(target=killer, daemon=True,  # lint: disable=FTH005 — exits with the watched proc; nothing outlives popen
+                             name="chaos-kill-watcher").start()
         return proc
 
     runner = ElasticRunner(cmd, ckpt_dir=run_dir, max_restarts=3,

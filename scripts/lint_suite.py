@@ -1,8 +1,11 @@
 #!/usr/bin/env python
 """One lint gate: ruff (generic style) + fedtorch_tpu.lint (TPU
-tracing hazards vs the checked-in baseline) + the registry-drift
-checker (FTC rules: metrics catalog, event names, fault seams,
-config<->CLI surface, builder-cell matrix — lint/registry_audit.py).
+tracing hazards vs the checked-in baseline) + the host-plane
+concurrency audit (FTH rules vs lint/concurrency_baseline.json,
+FTH001 cycles unbaselineable — lint/concurrency_audit.py) + the
+registry-drift checker (FTC rules: metrics catalog, event names,
+fault seams, config<->CLI surface, builder-cell matrix, lint-rule
+docs tables — lint/registry_audit.py).
 
 Exit status is non-zero when any half reports NEW findings, so CI
 and the tier-1 wrapper (tests/test_lint_suite.py) enforce all with a
@@ -46,6 +49,17 @@ def run_tracing_lint(argv=None) -> int:
     return lint_main(argv or [])
 
 
+def run_concurrency_audit() -> int:
+    """The FTH host-plane concurrency half (stdlib-only): FTH001
+    hard errors + soft findings not in concurrency_baseline.json."""
+    sys.path.insert(0, REPO)
+    from fedtorch_tpu.lint.concurrency_audit import concurrency_gate
+    new, total = concurrency_gate(REPO)
+    for f in new:
+        print(f.render())
+    return 1 if new else 0
+
+
 def run_registry_audit() -> int:
     """The FTC registry-drift half (stdlib-only, no baseline: drift
     is fixed at the registry or the emit site, never accepted)."""
@@ -83,6 +97,18 @@ def main(argv=None) -> int:
         failed = True
     else:
         print("lint_suite: fedtorch_tpu.lint clean vs baseline")
+
+    fth_rc = run_concurrency_audit()
+    if fth_rc != 0:
+        print("lint_suite: host-plane concurrency hazards (FTH) — "
+              "fix them, suppress with a justified "
+              "`# lint: disable=FTHxxx — why`, or (non-FTH001 only) "
+              "accept with `python -m fedtorch_tpu.lint --concurrency "
+              "--write-baseline` (docs/static_analysis.md "
+              "'The concurrency audit')")
+        failed = True
+    else:
+        print("lint_suite: concurrency audit clean (FTH)")
 
     ftc_rc = run_registry_audit()
     if ftc_rc != 0:

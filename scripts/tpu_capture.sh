@@ -100,6 +100,15 @@
 #                         — the tier-1 CPU audit re-run against the
 #                         real Mosaic/TPU lowering;
 #                         docs/static_analysis.md "The program audit")
+#   concurrency      python -m fedtorch_tpu.lint --concurrency
+#                        -> CONCURRENCY_AUDIT.json (host-plane FTH
+#                         lock/thread audit: lock-order cycles,
+#                         emit-under-lock, unlocked thread-shared
+#                         state, unbounded blocking, thread hygiene,
+#                         non-atomic run-dir writes — stdlib-only,
+#                         runs even when the relay's jax is wedged;
+#                         docs/static_analysis.md "The concurrency
+#                         audit")
 #
 # This supersedes the per-round stage chains (tpu_capture_full.sh,
 # tpu_capture_r4*.sh, tpu_capture_r5*.sh) — kept for session history;
@@ -122,7 +131,7 @@ TRIES="${TPU_CAPTURE_WAIT_TRIES:-90}"   # ~6 h of patience by default
 # the relay wedges mid-list
 # audit rides early: it is seconds of abstract lowering and proves the
 # program invariants on the real backend before the long benches run
-DEFAULT_STEPS="audit mfu stream builder-matrix avail async attack \
+DEFAULT_STEPS="audit concurrency mfu stream builder-matrix avail async attack \
 host-chaos cohort telemetry compare bench-streaming bench-dispatch \
 bench-unroll bench zoo pallas flash-train vmap baseline"
 STEPS="${*:-$DEFAULT_STEPS}"
@@ -219,6 +228,8 @@ for step in $STEPS; do
         curves)         run python scripts/northstar_synthetic.py ;;
         audit)          run python -m fedtorch_tpu.lint --audit \
                             --out PROGRAM_AUDIT.json ;;
+        concurrency)    run python -m fedtorch_tpu.lint --concurrency \
+                            --out CONCURRENCY_AUDIT.json ;;
         *) echo "[tpu_capture] unknown step: $step"; FAILED=1 ;;
     esac
 done

@@ -104,6 +104,11 @@ def test_host_fault_matrix_acceptance():
         assert cell["bitwise_identical"], seam
         assert cell["host_faults"] >= 1 or cell["host_degraded"] >= 1, \
             seam
+        # the lock-order sentinel was armed for the cell (a strict
+        # sentinel raises inside the drill on any inversion, so the
+        # presence of the recorded graph proves zero violations)
+        assert "lock_order" in cell, seam
+    assert report["lock_order_violations"] == 0
     assert matrix["stream.rebuild"]["stream_rebuilds"] >= 1
     assert matrix["ckpt.write"]["resume"]["bitwise"]
     assert matrix["ckpt.torn"]["resume"]["bitwise"]

@@ -1,0 +1,408 @@
+"""Fixture-driven tests for the host-plane concurrency analyzer (FTH
+rules) plus the head-of-tree gates.
+
+Mirrors tests/test_lint_analyzer.py: every rule gets a positive
+control (the hazard, asserted by exact rule id AND line number) and a
+negative control (the fixed idiom the rule must NOT flag). The two
+fixtures the issue calls out explicitly are here verbatim:
+
+* the PR 10 injector self-deadlock — first-fire announce emitted while
+  still holding the injector's own lock, which re-enters the events
+  writer from inside its flush path (FTH002), and its fixed
+  announce-outside-the-lock shape as the negative control;
+* the mid-flush writer-state mutation — a worker thread writing a
+  gauge that the main thread's stats() reads with no common lock
+  (FTH003), the class of bug the JsonlWriter three-lock discipline and
+  AsyncCheckpointer._gauges exist to prevent.
+
+Head gates at the bottom: zero FTH001 anywhere (hard errors cannot be
+baselined), the full audit clean vs lint/concurrency_baseline.json,
+and satellite hygiene — every thread the package spawns carries a
+stable ``name=``.
+"""
+import ast
+import os
+import textwrap
+
+from fedtorch_tpu.lint.concurrency_audit import (
+    CONCURRENCY_TARGETS, analyze_concurrency_source,
+    audit_concurrency_paths, concurrency_gate, split_hard_findings,
+)
+from fedtorch_tpu.lint.analyzer import iter_py_files
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def hits(src, rule=None, path="snippet.py"):
+    """[(rule, line)] findings for a dedented source snippet."""
+    out = analyze_concurrency_source(textwrap.dedent(src), path)
+    if rule is not None:
+        out = [f for f in out if f.rule == rule]
+    return [(f.rule, f.line) for f in out]
+
+
+# -- FTH002: emit under a lock (the PR 10 deadlock class) -------------------
+
+PR10_INJECTOR = """\
+import threading
+
+
+class HostFaultInjector:
+    def __init__(self, events):
+        self._lock = threading.Lock()
+        self._events = events
+        self._fired = 0
+
+    def check(self, seam):
+        with self._lock:
+            self._fired += 1
+            if self._fired == 1:
+                self._events.event("chaos.host_fault", seam=seam)
+"""
+
+PR10_INJECTOR_FIXED = """\
+import threading
+
+
+class HostFaultInjector:
+    def __init__(self, events):
+        self._lock = threading.Lock()
+        self._events = events
+        self._fired = 0
+
+    def check(self, seam):
+        fire = False
+        with self._lock:
+            self._fired += 1
+            if self._fired == 1:
+                fire = True
+        if fire:
+            self._events.event("chaos.host_fault", seam=seam)
+"""
+
+
+def test_fth002_pr10_injector_self_deadlock():
+    """The exact pre-fix PR 10 shape: the first-fire announce runs
+    with the injector's lock held — if the telemetry seam wraps the
+    writer whose flush re-enters check(), the process hangs."""
+    assert hits(PR10_INJECTOR) == [("FTH002", 14)]
+
+
+def test_fth002_fixed_announce_outside_lock_is_clean():
+    assert hits(PR10_INJECTOR_FIXED) == []
+
+
+def test_fth002_transitive_emit_through_helper():
+    """The emit need not be lexically inside the with-block: a helper
+    called under the lock that emits is the same hazard."""
+    src = """\
+    import threading
+
+
+    class R:
+        def __init__(self, events):
+            self._lock = threading.Lock()
+            self._events = events
+
+        def _announce(self, seam):
+            self._events.event("host.recovered", seam=seam)
+
+        def record(self, seam):
+            with self._lock:
+                self._announce(seam)
+    """
+    assert hits(src, "FTH002") == [("FTH002", 14)]
+
+
+# -- FTH001: lock-order cycles (hard, unbaselineable) -----------------------
+
+def test_fth001_two_lock_inversion_cycle():
+    src = """\
+    import threading
+
+
+    class Seams:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def one(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def two(self):
+            with self._b:
+                with self._a:
+                    pass
+    """
+    assert hits(src, "FTH001") == [("FTH001", 11)]
+
+
+def test_fth001_reacquire_via_call():
+    """flush() holds _mutex and calls _drain() which takes it again:
+    a guaranteed self-deadlock on non-reentrant locks."""
+    src = """\
+    import threading
+
+
+    class W:
+        def __init__(self):
+            self._mutex = threading.Lock()
+            self._buf = []
+
+        def flush(self):
+            with self._mutex:
+                self._drain()
+
+        def _drain(self):
+            with self._mutex:
+                self._buf.clear()
+    """
+    assert hits(src, "FTH001") == [("FTH001", 11)]
+
+
+def test_fth001_is_hard_and_never_baselined():
+    fs = analyze_concurrency_source(textwrap.dedent("""\
+    import threading
+
+
+    class Seams:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def one(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def two(self):
+            with self._b:
+                with self._a:
+                    pass
+    """), "snippet.py")
+    hard, soft = split_hard_findings(fs)
+    assert [f.rule for f in hard] == ["FTH001"]
+    assert soft == []
+
+
+def test_fth001_consistent_order_is_clean():
+    src = """\
+    import threading
+
+
+    class Seams:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def one(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def two(self):
+            with self._a:
+                with self._b:
+                    pass
+    """
+    assert hits(src) == []
+
+
+# -- FTH003: unlocked thread-shared state -----------------------------------
+
+MIDFLUSH_WRITER = """\
+import threading
+
+
+class Writer:
+    def __init__(self):
+        self._mutex = threading.Lock()
+        self._rows = 0
+        self._t = threading.Thread(target=self._worker,
+                                   name="writer-flush", daemon=True)
+        self._t.start()
+
+    def _worker(self):
+        self._rows += 1
+
+    def stats(self):
+        return {"rows": self._rows}
+
+    def close(self):
+        self._t.join(timeout=5.0)
+"""
+
+
+def test_fth003_worker_written_gauge_read_unlocked():
+    """The mid-flush mutation class: the worker mutates writer state
+    that the main thread's stats() snapshot reads with no common
+    lock — the AsyncCheckpointer gauges bug fixed in this PR."""
+    assert hits(MIDFLUSH_WRITER, "FTH003") == [("FTH003", 16)]
+
+
+def test_fth003_common_lock_on_both_sides_is_clean():
+    src = """\
+    import threading
+
+
+    class Writer:
+        def __init__(self):
+            self._mutex = threading.Lock()
+            self._rows = 0
+            self._t = threading.Thread(target=self._worker,
+                                       name="writer-flush", daemon=True)
+            self._t.start()
+
+        def _worker(self):
+            with self._mutex:
+                self._rows += 1
+
+        def stats(self):
+            with self._mutex:
+                return {"rows": self._rows}
+
+        def close(self):
+            self._t.join(timeout=5.0)
+    """
+    assert hits(src) == []
+
+
+# -- FTH004: unbounded blocking ---------------------------------------------
+
+def test_fth004_unbounded_get_while_holding_lock():
+    src = """\
+    import queue
+    import threading
+
+
+    class Pipe:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._q = queue.Queue()
+
+        def drain(self):
+            with self._lock:
+                return self._q.get()
+
+        def drain_bounded(self):
+            return self._q.get(timeout=1.0)
+    """
+    assert hits(src, "FTH004") == [("FTH004", 12)]
+
+
+# -- FTH005: thread hygiene -------------------------------------------------
+
+def test_fth005_unnamed_and_unjoined_threads():
+    src = """\
+    import threading
+
+
+    def spawn(fn):
+        t = threading.Thread(target=fn)
+        t.start()
+        d = threading.Thread(target=fn, name="d", daemon=True)
+        d.start()
+        return t, d
+    """
+    assert hits(src, "FTH005") == [("FTH005", 5), ("FTH005", 7)]
+
+
+def test_fth005_named_and_joined_is_clean():
+    src = """\
+    import threading
+
+
+    class P:
+        def __init__(self, fn):
+            self._t = threading.Thread(target=fn, name="prefetch",
+                                       daemon=True)
+            self._t.start()
+
+        def close(self):
+            self._t.join(timeout=5.0)
+    """
+    assert hits(src) == []
+
+
+# -- FTH006: non-atomic artifact writes -------------------------------------
+
+def test_fth006_bare_write_in_package_file():
+    src = """\
+    import json
+    import os
+
+
+    def save(report, path):
+        with open(path, "w") as fh:
+            json.dump(report, fh)
+
+
+    def save_atomic(report, path):
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(report, fh)
+        os.replace(tmp, path)
+    """
+    # only the non-atomic writer: the tmp+os.replace protocol is clean
+    assert hits(src, path="fedtorch_tpu/fake_mod.py") == [("FTH006", 6)]
+
+
+def test_fth006_silent_outside_the_package():
+    src = """\
+    def save(report, path):
+        with open(path, "w") as fh:
+            fh.write(report)
+    """
+    assert hits(src, path="tests/fake_helper.py") == []
+
+
+# -- suppression comments ---------------------------------------------------
+
+def test_fth_suppression_comment_respected():
+    src = """\
+    import threading
+
+
+    def spawn(fn):
+        t = threading.Thread(target=fn)  # lint: disable=FTH005 — test fixture
+        t.start()
+        return t
+    """
+    assert hits(src) == []
+
+
+# -- head-of-tree gates -----------------------------------------------------
+
+def test_zero_fth001_at_head():
+    """Lock-order cycles are hard errors: none may exist anywhere in
+    the tree, baselined or not (ISSUE 17 acceptance)."""
+    hard, _ = split_hard_findings(audit_concurrency_paths(REPO))
+    assert hard == [], "\n".join(f.render() for f in hard)
+
+
+def test_head_clean_vs_concurrency_baseline():
+    """The CI gate: every finding at head is either fixed, justified
+    with a suppression comment, or pinned in concurrency_baseline.json
+    (and FTH001 never pins)."""
+    new, total = concurrency_gate(REPO)
+    assert new == [], "\n".join(f.render() for f in new)
+    assert total > 0, "the audit found nothing at all — scan broken?"
+
+
+def test_every_spawned_thread_is_named():
+    """Satellite hygiene: every ``threading.Thread(...)`` spawn in the
+    package and scripts/ carries a stable ``name=`` so watchdog stack
+    dumps and the lock sentinel's per-thread reports are attributable.
+    Checked directly on the AST (independent of FTH005 suppressions)."""
+    unnamed = []
+    for full in iter_py_files(REPO, CONCURRENCY_TARGETS):
+        tree = ast.parse(open(full, encoding="utf-8").read())
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "Thread"
+                    and not any(k.arg == "name" for k in node.keywords)):
+                unnamed.append(f"{full}:{node.lineno}")
+    assert unnamed == [], f"unnamed threads: {unnamed}"

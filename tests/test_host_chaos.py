@@ -516,31 +516,41 @@ class TestTelemetrySeams:
         mutex this self-deadlocked (confirmed), and a seam check under
         the open-lock deadlocked the same way at sub-1.0 rates (the
         announce lands on a flush that proceeds to open the file); the
-        flush must run the seam check with NO writer lock held."""
+        flush must run the seam check with NO writer lock held.
+
+        The lock-order sentinel rides the whole path: the writers' and
+        injector's locks are created inside its scope, so a re-entrant
+        acquire (the original hang) raises immediately instead of
+        hanging, and any order inversion between the three writer
+        locks fails the test at exit."""
         import threading
         from fedtorch_tpu.telemetry import Telemetry
+        from fedtorch_tpu.utils.lock_sentinel import LockOrderSentinel
         _ledger()
-        tel = Telemetry(str(tmp_path), level="default").install()
-        inj = host_chaos.HostFaultInjector(("telemetry.write",),
-                                           rate=rate, seed=1).install()
-        done = threading.Event()
+        with LockOrderSentinel() as locks:
+            tel = Telemetry(str(tmp_path), level="default").install()
+            inj = host_chaos.HostFaultInjector(
+                ("telemetry.write",), rate=rate, seed=1).install()
+            done = threading.Event()
 
-        def emit():
-            # every event flushes; rate 1.0 makes the first flush's
-            # check the announcing fire
-            for _ in range(5):
-                tel.event("probe")
-            done.set()
+            def emit():
+                # every event flushes; rate 1.0 makes the first
+                # flush's check the announcing fire
+                for _ in range(5):
+                    tel.event("probe")
+                done.set()
 
-        t = threading.Thread(target=emit, daemon=True)
-        t.start()
-        try:
-            assert done.wait(20.0), \
-                "telemetry event emission deadlocked under injection"
-        finally:
-            inj.uninstall()
-            tel.close()
-        assert inj.total_fires() >= 1
+            t = threading.Thread(target=emit, daemon=True,
+                                 name="chaos-emit-probe")
+            t.start()
+            try:
+                assert done.wait(20.0), \
+                    "telemetry event emission deadlocked under injection"
+            finally:
+                inj.uninstall()
+                tel.close()
+            assert inj.total_fires() >= 1
+            locks.assert_clean()
 
     def test_health_degrades_to_off_after_consecutive_failures(
             self, tmp_path):

@@ -1,0 +1,137 @@
+"""Unit tests for the runtime lock-order sentinel — the dynamic half
+of the FTH concurrency audit (docs/static_analysis.md "The runtime
+half: the lock-order sentinel").
+
+The sentinel must (a) turn a forced two-lock order inversion into a
+violation raised at scope exit, (b) turn a re-entrant acquire — the
+PR 10 injector self-deadlock shape — into an IMMEDIATE AssertionError
+instead of a hang, (c) stay silent on consistently-ordered runs, and
+(d) leave no trace after exit: the faults.new_lock factory hook is
+restored and watched attributes are swapped back.
+"""
+import threading
+
+import pytest
+
+from fedtorch_tpu.telemetry import faults as tel_faults
+from fedtorch_tpu.utils.lock_sentinel import (
+    LockOrderSentinel, active_sentinel,
+)
+
+
+def test_clean_ordered_run_passes_and_records_edges():
+    with LockOrderSentinel() as s:
+        x = tel_faults.new_lock("X")
+        y = tel_faults.new_lock("Y")
+        for _ in range(3):
+            with x:
+                with y:
+                    pass
+        assert s.order_edges() == {"X": ["Y"]}
+        s.assert_clean()
+    # strict __exit__ already re-asserted clean; no violations recorded
+    assert s.violations == []
+
+
+def test_two_lock_inversion_raises_at_exit():
+    """Thread A takes X->Y, thread B takes Y->X: the classic deadlock
+    recipe. Serialized via events so the runs interleave without
+    actually deadlocking — the sentinel must still flag the ORDER."""
+    with pytest.raises(AssertionError, match="lock-order inversion"):
+        with LockOrderSentinel() as s:
+            x = tel_faults.new_lock("X")
+            y = tel_faults.new_lock("Y")
+
+            with x:
+                with y:
+                    pass
+
+            def inverted():
+                with y:
+                    with x:
+                        pass
+
+            t = threading.Thread(target=inverted,
+                                 name="sentinel-test-inverter")
+            t.start()
+            t.join()
+            assert s.violations, "inversion not recorded"
+
+
+def test_inversion_nonstrict_reports_via_assert_clean():
+    with LockOrderSentinel(strict=False) as s:
+        x = tel_faults.new_lock("X")
+        y = tel_faults.new_lock("Y")
+        with x:
+            with y:
+                pass
+
+        def inverted():
+            with y:
+                with x:
+                    pass
+
+        t = threading.Thread(target=inverted,
+                             name="sentinel-test-inverter")
+        t.start()
+        t.join()
+    assert len(s.violations) == 1
+    with pytest.raises(AssertionError, match="1 violation"):
+        s.assert_clean()
+
+
+def test_reentrant_acquire_raises_immediately():
+    """The PR 10 self-deadlock shape: re-acquiring a held
+    non-reentrant lock must raise NOW, not hang the process."""
+    with LockOrderSentinel(strict=False) as s:
+        m = tel_faults.new_lock("W._mutex")
+        with m:
+            with pytest.raises(AssertionError, match="re-entrant"):
+                m.acquire()
+        assert any("PR 10" in v for v in s.violations)
+
+
+def test_watch_wraps_and_restores_existing_locks():
+    class Holder:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._rlock = threading.RLock()
+
+    h = Holder()
+    original = h._lock
+    with LockOrderSentinel() as s:
+        s.watch(h, "_lock", "_rlock")
+        assert h._lock is not original
+        with h._lock:
+            pass
+        # RLocks are re-entrant by contract: no false positive
+        with h._rlock:
+            with h._rlock:
+                pass
+    assert h._lock is original
+    assert s.violations == []
+
+
+def test_hook_and_active_sentinel_restored_after_exit():
+    assert active_sentinel() is None
+    with LockOrderSentinel() as s:
+        assert active_sentinel() is s
+        wrapped = tel_faults.new_lock("inner")
+        assert wrapped.name == "inner"
+    assert active_sentinel() is None
+    # hook restored: new_lock now returns a plain threading.Lock
+    plain = tel_faults.new_lock("after")
+    assert type(plain) is type(threading.Lock())
+    # wrappers that outlive the sentinel degrade to pass-through
+    with wrapped:
+        pass
+    assert s.violations == []
+
+
+def test_nested_sentinels_restore_outer_hook():
+    with LockOrderSentinel() as outer:
+        with LockOrderSentinel() as inner:
+            assert active_sentinel() is inner
+        assert active_sentinel() is outer
+        lk = tel_faults.new_lock("back-to-outer")
+        assert lk._sentinel is outer

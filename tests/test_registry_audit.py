@@ -177,11 +177,35 @@ def test_docs_tables_match_rules_registry():
     rules.py — byte-for-byte, so the docs cannot drift from the
     registry (the tables are generated, not hand-maintained)."""
     from fedtorch_tpu.lint.rules import (
-        PROGRAM_RULES, REGISTRY_RULES, markdown_table,
+        CONCURRENCY_RULES, PROGRAM_RULES, REGISTRY_RULES, markdown_table,
     )
     doc = open(os.path.join(REPO, "docs/static_analysis.md")).read()
+    assert markdown_table(CONCURRENCY_RULES) in doc
     assert markdown_table(PROGRAM_RULES) in doc
     assert markdown_table(REGISTRY_RULES) in doc
+
+
+# -- FTC006: lint-rule docs drift --------------------------------------------
+
+def test_ftc006_missing_fth_id_flagged():
+    """A registered FTH id absent from the docs tables is FTC006."""
+    from fedtorch_tpu.lint.registry_audit import (
+        diff_rule_docs, documented_rule_ids,
+    )
+    doc = "| `FTH001` | x | y |\n| `FTP001` | x | y |\n"
+    fs = diff_rule_docs({"FTH001", "FTH002", "FTP001"},
+                        documented_rule_ids(doc))
+    assert [f.rule for f in fs] == ["FTC006"]
+    assert "FTH002" in fs[0].message
+
+
+def test_ftc006_documented_ids_pass():
+    from fedtorch_tpu.lint.registry_audit import (
+        diff_rule_docs, documented_rule_ids,
+    )
+    doc = "| `FTH001` | x |\n| `FTH002` | y |\n"
+    assert diff_rule_docs({"FTH001", "FTH002"},
+                          documented_rule_ids(doc)) == []
 
 
 def test_head_doc_field_extraction_is_sane():

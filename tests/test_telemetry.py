@@ -146,6 +146,37 @@ class TestMetricsSchema:
         w.close()
         assert w.write_errors >= 1
 
+    def test_concurrent_writers_hold_lock_order(self, tmp_path):
+        # the three-lock discipline (_mutex buffer-only, _open_lock,
+        # _io_lock) under real contention: N threads hammer write()
+        # while the main thread flushes — the lock-order sentinel
+        # wraps the writer's locks at construction and fails the test
+        # on any order inversion or re-entrant acquire (the PR 10
+        # self-deadlock shape), instead of hanging it
+        from fedtorch_tpu.utils.lock_sentinel import LockOrderSentinel
+
+        path = str(tmp_path / "metrics.jsonl")
+        with LockOrderSentinel() as locks:
+            w = JsonlWriter(path, METRICS_SCHEMA, flush_rows=4)
+
+            def hammer(base):
+                for r in range(20):
+                    w.write(dict(VALID_ROW, round=base + r))
+
+            threads = [threading.Thread(target=hammer, args=(i * 100,),
+                                        name=f"hammer-{i}")
+                       for i in range(4)]
+            for t in threads:
+                t.start()
+            for _ in range(10):
+                w.flush()
+            for t in threads:
+                t.join()
+            w.close()
+            locks.assert_clean()
+        rows = [r for r in iter_jsonl(path) if "round" in r]
+        assert len(rows) == 80 and w.write_errors == 0
+
 
 # -- host spans --------------------------------------------------------------
 class TestSpanRecorder:
