@@ -47,6 +47,24 @@ class FedAlgorithm:
     # (PerFedAvg's MAML outer step; requires cfg.federated.personal)
     needs_val_batch = False
 
+    # True when the host RoundSchedule can replay this algorithm's
+    # participation draw bit-exactly (the stream plane's precondition:
+    # the feed packer must know the cohort before the round runs). The
+    # base default samples uniformly from the round key alone, which
+    # the schedule replays; an override that reads DEVICE state the
+    # host cannot see (DRFA's lambda-distributed sampling) must leave
+    # this False — the cell validator refuses the feed source then.
+    # Subclasses overriding ``participation`` with a replayable draw
+    # flip this True (or make it a property over their config).
+    participation_replayable = True
+
+    # True when the algorithm's ``post_round_global`` phase can run on
+    # the stream plane from a host-packed probe (``host_probe_fn`` +
+    # ``post_round_global_feed`` below — DRFA's dual update). False
+    # with an overridden ``post_round_global`` means the feed source
+    # is refused (the phase needs full data access).
+    needs_post_probe = False
+
     def __init__(self, cfg: ExperimentConfig):
         self.cfg = cfg
         self.model = None
@@ -117,6 +135,27 @@ class FedAlgorithm:
         """Optional second phase after aggregation with full data access
         (DRFA's kth-model loss collection + dual update,
         drfa.py:215-249). Returns the updated ServerState."""
+        return server
+
+    def host_probe_fn(self, sizes):
+        """Host replica of the ``post_round_global`` phase's DATA
+        plan, for the stream plane (``needs_post_probe``): return a
+        closure ``probe(rng_round) -> (probe_idx, probe_rows)`` that
+        replays — on the CPU backend, bit-exactly — which clients' and
+        which storage rows the post phase will consume, from the same
+        round key chain the device phase folds. The feed packer
+        gathers those rows into ``RoundFeed.probe_*``. None (default)
+        = no probe."""
+        return None
+
+    def post_round_global_feed(self, server, probe, rng):
+        """The ``post_round_global`` twin for the stream plane: same
+        math, but over the pre-gathered probe batches (a ``RoundFeed``
+        with ``probe_idx``/``probe_x``/``probe_y``) instead of the
+        full data pytree — O(k) device work, no [C, n_max, ...]
+        input. Must be bitwise-identical to ``post_round_global``
+        given the probe ``host_probe_fn`` planned. Returns the updated
+        ServerState."""
         return server
 
     def pre_round(self, on_aux, *, server, x, y, sizes, lr, rng):

@@ -39,10 +39,21 @@ from fedtorch_tpu.ops.simplex import project_simplex_floor
 
 class DRFA(FedAlgorithm):
     name = "drfa"
+    # the dual phase streams: host_probe_fn plans the second-phase
+    # batches, post_round_global_feed consumes them from the feed
+    needs_post_probe = True
 
     def __init__(self, cfg, inner: FedAlgorithm):
         super().__init__(cfg)
         self.inner = inner
+
+    @property
+    def participation_replayable(self):
+        # the default-uniform draw replays on the host bit-exactly;
+        # lambda-distributed sampling reads DEVICE state (the dual
+        # variable) the host schedule cannot see — the cell validator
+        # keeps that variant off the feed source
+        return not self.cfg.federated.drfa_lambda_sampling
 
     # -- delegation helpers ------------------------------------------------
     def setup(self, data):
@@ -183,6 +194,12 @@ class DRFA(FedAlgorithm):
                                             model.is_regression))
 
         losses = jax.vmap(one_loss)(idx2, jax.random.split(rng_batch, k))
+        return self._dual_update(server, idx2, losses)
+
+    def _dual_update(self, server, idx2, losses):
+        """The dual ascent shared by both data planes: scatter the
+        probe losses into [C], step lambda, project (drfa.py:239-249)."""
+        C = self.cfg.federated.num_clients
         num_online2 = num_online_effective(idx2)
         lam = server.aux["lambda"]
         # per-round decayed dual step size (drfa.py:77 gamma *= 0.9)
@@ -194,3 +211,46 @@ class DRFA(FedAlgorithm):
         lam = project_simplex_floor(lam, floor=1e-3)
         return server._replace(
             aux=dict(server.aux, **{"lambda": lam, "gamma": gamma}))
+
+    def host_probe_fn(self, sizes):
+        """Host replay of the second phase's data plan: the SAME
+        ``fold_in(rng_round, 99)`` → split → uniform permutation →
+        per-client ``sample_batch`` index draw the device phase
+        consumes (threefry is backend-deterministic, so the cohort and
+        rows are bit-exact). Runs inside the jitted RoundSchedule on
+        the CPU backend."""
+        C = self.cfg.federated.num_clients
+        k = self.k_online
+        B = self.cfg.data.batch_size
+        sizes32 = jnp.asarray(sizes, jnp.int32)
+
+        def probe(rng_round):
+            rng = jax.random.fold_in(rng_round, 99)
+            rng_idx, rng_batch = jax.random.split(rng)
+            idx2 = jax.random.permutation(rng_idx, C)[:k]
+            rngs = jax.random.split(rng_batch, k)
+            on_sizes = jnp.take(sizes32, idx2)
+            # sample_batch's exact index draw (data/batching.py)
+            rows = jax.vmap(lambda r, s: jax.random.randint(
+                r, (B,), 0, jnp.maximum(s, 1)))(rngs, on_sizes)
+            return idx2, rows
+        return probe
+
+    def post_round_global_feed(self, server, probe, rng):
+        """The dual phase on the stream plane: the probe cohort's
+        batches arrive pre-gathered in the feed (``probe_idx`` IS the
+        ``permutation(rng_idx, C)[:k]`` draw — the host replayed it
+        from the same key), so the device does O(k) probe work with no
+        [C, n_max, ...] input. Bitwise-identical lambda trajectory to
+        :meth:`post_round_global` (tests/test_streaming.py)."""
+        kth_avg = server.aux["kth_avg"]
+        model = self.model
+
+        def one_loss(bx, by):
+            # fresh hidden for the kth-model probe (centered/drfa.py:242)
+            logits = self.forward_reset(kth_avg, bx)
+            return jnp.mean(per_sample_loss(logits, by,
+                                            model.is_regression))
+
+        losses = jax.vmap(one_loss)(probe.probe_x, probe.probe_y)
+        return self._dual_update(server, probe.probe_idx, losses)

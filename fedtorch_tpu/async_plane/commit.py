@@ -191,6 +191,13 @@ class AsyncFederatedTrainer(FederatedTrainer):
         # commit, so a later live histogram supersedes the stash)
         self._hist_stash: Optional[dict] = None
 
+    @property
+    def metrics_width(self) -> int:
+        """Sparse-mode commits emit [m]-wide cohort metrics — the m
+        buffered jobs ARE the commit's cohort (perm keeps [C])."""
+        return self.buffer_size if self.participation_mode == "sparse" \
+            else self.num_clients
+
     # -- state -----------------------------------------------------------
     def init_state(self, rng: jax.Array):
         """Sync init, then wrap the server aux with the snapshot ring:
@@ -209,6 +216,9 @@ class AsyncFederatedTrainer(FederatedTrainer):
         return dict(
             num_clients=self.num_clients, concurrency=self.concurrency,
             buffer_size=self.buffer_size, ring_size=self.snapshot_ring,
+            # 'sparse' keeps selection O(1) per dispatch at
+            # million-client populations (scheduler rejection draw)
+            participation_mode=self.participation_mode,
             straggler_rate=flt.straggler_rate,
             straggler_step_frac=flt.straggler_step_frac,
             # the arrival model (robustness/availability.py): the

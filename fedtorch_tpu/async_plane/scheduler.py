@@ -94,9 +94,14 @@ class AsyncSchedule:
                  concurrency: int, buffer_size: int, ring_size: int,
                  straggler_rate: float, straggler_step_frac: float,
                  jitter: float = 0.25, start_commit: int = 0,
-                 model: AvailabilityModel = None):
+                 model: AvailabilityModel = None,
+                 participation_mode: str = "perm"):
         if buffer_size < 1 or concurrency < 1:
             raise ValueError("buffer_size and concurrency must be >= 1")
+        if participation_mode not in ("perm", "sparse"):
+            raise ValueError(
+                f"participation_mode must be 'perm' or 'sparse', got "
+                f"{participation_mode!r}")
         if num_clients < concurrency + buffer_size:
             raise ValueError(
                 f"async plane needs num_clients >= concurrency + "
@@ -107,6 +112,11 @@ class AsyncSchedule:
         self.concurrency = concurrency
         self.buffer_size = buffer_size
         self.ring_size = ring_size
+        # 'perm' draws a [C] uniform score vector per selection (the
+        # legacy bitwise-pinned stream); 'sparse' draws SCALAR uniform
+        # ids with rejection — O(1) memory per draw, the
+        # million-client mode (config.PARTICIPATION_MODES)
+        self.participation_mode = participation_mode
         self._rate = float(straggler_rate)
         self._tail = 1.0 / float(straggler_step_frac)
         self._jitter = float(jitter)
@@ -124,10 +134,19 @@ class AsyncSchedule:
 
             delays = self._model.traced
 
-            def select(key, select_id):
-                r = jax.random.fold_in(
-                    jax.random.fold_in(key, _SELECT_SALT), select_id)
-                return jax.random.uniform(r, (num_clients,))
+            if participation_mode == "sparse":
+                def select(key, select_id):
+                    r = jax.random.fold_in(
+                        jax.random.fold_in(key, _SELECT_SALT),
+                        select_id)
+                    return jax.random.randint(r, (), 0, num_clients,
+                                              dtype=jnp.int32)
+            else:
+                def select(key, select_id):
+                    r = jax.random.fold_in(
+                        jax.random.fold_in(key, _SELECT_SALT),
+                        select_id)
+                    return jax.random.uniform(r, (num_clients,))
 
             # the key input is reused by every draw — donation would
             # invalidate it; outputs are a few bytes
@@ -157,9 +176,20 @@ class AsyncSchedule:
 
         # initial cohort: ``concurrency`` distinct clients against
         # version 0 at time 0
-        scores = self._select_scores()
-        for c in np.argsort(scores, kind="stable")[:concurrency]:
-            self._dispatch(int(c), version=0, now=0.0)
+        if participation_mode == "sparse":
+            cohort: List[int] = []
+            taken: Set[int] = set()
+            while len(cohort) < concurrency:
+                c = self._select_id()
+                if c not in taken:
+                    taken.add(c)
+                    cohort.append(c)
+            for c in cohort:
+                self._dispatch(c, version=0, now=0.0)
+        else:
+            scores = self._select_scores()
+            for c in np.argsort(scores, kind="stable")[:concurrency]:
+                self._dispatch(int(c), version=0, now=0.0)
         for _ in range(start_commit):
             self.next_commit()
 
@@ -171,6 +201,16 @@ class AsyncSchedule:
             s = self._select_jit(self._key, np.int32(self._select_count))
             self._select_count += 1
             return np.asarray(jax.device_get(s))
+
+    def _select_id(self) -> int:
+        """One SCALAR uniform client draw ('sparse' mode) — same
+        (salt, count) fold chain as the perm scores, but O(1) memory;
+        the count advances per DRAW, so rejections consume entropy
+        deterministically."""
+        with self._scope():
+            c = self._select_jit(self._key, np.int32(self._select_count))
+            self._select_count += 1
+            return int(jax.device_get(c))
 
     def _draw_delays(self, dispatch_ids: np.ndarray,
                      clients: np.ndarray, versions: np.ndarray):
@@ -198,6 +238,16 @@ class AsyncSchedule:
         self._inflight.add(client)
 
     def _pick_replacement(self, exclude: Set[int]) -> int:
+        if self.participation_mode == "sparse":
+            # rejection sampling: |exclude| <= concurrency +
+            # buffer_size - 1 < num_clients (constructor guard), so
+            # acceptance probability is > 0 and at million-client
+            # scale is ~1 — expected O(1) scalar draws, never a [C]
+            # score vector
+            while True:
+                c = self._select_id()
+                if c not in exclude:
+                    return c
         scores = self._select_scores()
         for c in np.argsort(scores, kind="stable"):
             if int(c) not in exclude:

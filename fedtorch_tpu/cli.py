@@ -19,6 +19,7 @@ import os
 import time
 
 from fedtorch_tpu.config import (
+    CLIENT_STORES, PARTICIPATION_MODES,
     CheckpointConfig, DataConfig, ExperimentConfig, FaultConfig,
     FederatedConfig, LRConfig, MeshConfig, ModelConfig, OptimConfig,
     TelemetryConfig, TrainConfig,
@@ -173,6 +174,29 @@ def build_parser() -> argparse.ArgumentParser:
                         "round ahead, overlapping the transfer with "
                         "the previous round's compute "
                         "(docs/performance.md 'Streaming data plane')")
+    p.add_argument("--data_store", default="ram",
+                   choices=CLIENT_STORES,
+                   help="client-store backend on the stream plane: "
+                        "'ram' (default) holds the [C, n_max, ...] "
+                        "population in host memory; 'mmap' memory-maps "
+                        "a sharded on-disk store built by "
+                        "save_client_store — host residency is "
+                        "O(touched rows), enabling million-client "
+                        "populations (docs/performance.md 'The "
+                        "million-client store')")
+    p.add_argument("--data_store_dir", default="",
+                   help="directory holding the mmap store's "
+                        "manifest.json + shard files (required with "
+                        "--data_store mmap)")
+    p.add_argument("--participation_mode", default="perm",
+                   choices=PARTICIPATION_MODES,
+                   help="per-round client sampling: 'perm' (default, "
+                        "legacy-bitwise) draws a [C] random score "
+                        "vector per selection; 'sparse' draws O(k) "
+                        "without-replacement ids and never "
+                        "materializes a [C] array — required reading "
+                        "at million-client populations "
+                        "(docs/performance.md)")
     p.add_argument("--growing_batch_size", type=str2bool, default=False)
     p.add_argument("--base_batch_size", type=int, default=None)
     p.add_argument("--max_batch_size", type=int, default=0)
@@ -480,6 +504,8 @@ def args_to_config(args) -> ExperimentConfig:
             synthetic_beta=args.synthetic_beta,
             sensitive_feature=args.sensitive_feature,
             data_plane=args.data_plane,
+            store=args.data_store,
+            store_dir=args.data_store_dir,
             batch_size=args.batch_size,
             growing_batch_size=args.growing_batch_size,
             base_batch_size=args.base_batch_size,
@@ -494,6 +520,7 @@ def args_to_config(args) -> ExperimentConfig:
             sync_type=args.federated_sync_type,
             num_epochs_per_comm=args.num_epochs_per_comm,
             sync_mode=args.sync_mode,
+            participation_mode=args.participation_mode,
             async_buffer_size=args.async_buffer_size,
             async_concurrency=args.async_concurrency,
             staleness_weight=args.staleness_weight,

@@ -66,6 +66,23 @@ HOST_FAULT_SEAMS = (
 # implementation imports THIS tuple.
 AVAILABILITY_MODELS = ("default", "trace")
 
+# Client-store implementations behind the stream plane's feed packer
+# (data/streaming.py ClientStore; docs/performance.md "The
+# million-client store"): 'ram' keeps the [C, n_max, ...] population
+# arrays host-resident (the seed behavior — population capped by host
+# RAM); 'mmap' memory-maps a manifest-described sharded file layout
+# from data.store_dir, so host residency is O(feed) and population is
+# capped by disk. Declared here so config validation stays stdlib-only.
+CLIENT_STORES = ("ram", "mmap")
+
+# Participation-sampling modes (parallel/federated.py
+# participation_indices): 'perm' is the legacy full-permutation draw
+# (bitwise-pinned by every parity test — O(C log C) per round); 'sparse'
+# is the O(k)-memory sparse Fisher-Yates draw that never materializes a
+# [C] array (million-client populations). Both are replayed bit-exactly
+# by the host RoundSchedule and the async scheduler.
+PARTICIPATION_MODES = ("perm", "sparse")
+
 FEDERATED_ALGORITHMS = (
     "fedavg", "scaffold", "fedprox", "fedgate", "fedadam", "apfl", "afl",
     "perfedavg", "qsparse", "perfedme", "qffl",
@@ -120,6 +137,17 @@ class DataConfig:
     # (vmap | fused) the cell validator allows
     # (parallel/round_program.py).
     data_plane: str = "device"
+    # Host client-store implementation behind the stream plane's feed
+    # packer (CLIENT_STORES; docs/performance.md "The million-client
+    # store"): 'ram' holds the population in host memory, 'mmap' maps
+    # the sharded on-disk layout at ``store_dir`` (built by
+    # data/streaming.py save_client_store / MmapStoreWriter) so host
+    # residency stays O(feed) while the population scales to disk.
+    # 'mmap' requires data_plane='stream' — the device plane uploads
+    # the whole store to HBM, which is exactly what mmap exists to
+    # avoid.
+    store: str = "ram"
+    store_dir: str = ""
     # Batching (ref: parameters.py:131-141).
     batch_size: int = 50
     growing_batch_size: bool = False
@@ -147,6 +175,13 @@ class FederatedConfig:
     sync_type: str = "epoch"  # 'epoch' | 'local_step'
     num_epochs_per_comm: int = 1
     algorithm: str = "fedavg"  # --federated_type
+    # How the k online clients are drawn each round
+    # (PARTICIPATION_MODES): 'perm' = the legacy full-permutation
+    # sample (misc.py:10-19 — trajectories bitwise-pinned); 'sparse' =
+    # the O(k)-memory draw for million-client populations (same
+    # uniform without-replacement law, different stream). Replayed
+    # bit-exactly by the host schedule and the async scheduler.
+    participation_mode: str = "perm"
     # Server execution plane (docs/robustness.md "Asynchronous
     # federation"): 'sync' (default, the reference-faithful seed
     # behavior) blocks each round on all k online clients; 'async' is
@@ -723,6 +758,25 @@ class ExperimentConfig:
             raise ValueError(
                 f"data.data_plane must be 'device' or 'stream', got "
                 f"{data.data_plane!r}")
+        if data.store not in CLIENT_STORES:
+            raise ValueError(
+                f"data.store must be one of {CLIENT_STORES}, got "
+                f"{data.store!r}")
+        if data.store == "mmap":
+            if data.data_plane != "stream":
+                raise ValueError(
+                    "data.store='mmap' is a stream-plane client store "
+                    "(the device plane would upload the whole mapped "
+                    "population to HBM); set data.data_plane='stream'")
+            if not data.store_dir:
+                raise ValueError(
+                    "data.store='mmap' needs data.store_dir — the "
+                    "directory holding the manifest-described shard "
+                    "layout (data/streaming.py save_client_store)")
+        if fed.participation_mode not in PARTICIPATION_MODES:
+            raise ValueError(
+                f"federated.participation_mode must be one of "
+                f"{PARTICIPATION_MODES}, got {fed.participation_mode!r}")
         if fed.sync_mode not in ("sync", "async"):
             raise ValueError(
                 f"federated.sync_mode must be 'sync' or 'async', got "

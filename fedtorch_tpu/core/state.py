@@ -43,10 +43,18 @@ class RoundMetrics(NamedTuple):
     the robustness counters (docs/robustness.md): a client that crashed
     mid-round is removed from ``online_mask`` (it contributed nothing),
     and the fault scalars record what the chaos layer and the update
-    guards did this round. All are 0 when faults/guards are off."""
-    train_loss: jnp.ndarray   # [C] mean local loss (masked)
-    train_acc: jnp.ndarray    # [C] mean local top-1 (masked)
-    online_mask: jnp.ndarray  # [C]
+    guards did this round. All are 0 when faults/guards are off.
+
+    The three per-client leaves are [C] under the legacy 'perm'
+    participation mode and cohort-aligned [k] under 'sparse' (the
+    million-client mode never materializes a [C] vector per round —
+    docs/performance.md "The million-client store"); every shipped
+    consumer reduces them by sum, which is layout-invariant because
+    offline rows are zeroed. ``FederatedTrainer.metrics_width`` names
+    the active width for shape-matching consumers."""
+    train_loss: jnp.ndarray   # [C]|[k] mean local loss (masked)
+    train_acc: jnp.ndarray    # [C]|[k] mean local top-1 (masked)
+    online_mask: jnp.ndarray  # [C]|[k]
     comm_bytes: jnp.ndarray   # scalar — payload volume this round
     dropped_clients: jnp.ndarray = 0.0    # scalar — chaos crashes
     straggler_clients: jnp.ndarray = 0.0  # scalar — step-budget cuts

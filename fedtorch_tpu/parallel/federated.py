@@ -79,7 +79,7 @@ from fedtorch_tpu.data.batching import (
     round_row_plan, take_batch,
 )
 from fedtorch_tpu.data.streaming import (
-    HostClientStore, RoundFeed, StreamFeedProducer,
+    HostClientStore, MmapClientStore, RoundFeed, StreamFeedProducer,
 )
 from fedtorch_tpu.models.common import ModelDef
 from fedtorch_tpu.ops.augment import augment_image_batch
@@ -107,13 +107,51 @@ from fedtorch_tpu.robustness.guards import (
 from fedtorch_tpu.utils.tracing import instrument_trace
 
 
+def _sparse_participation(rng: jax.Array, num_clients: int,
+                          k: int) -> jnp.ndarray:
+    """Uniform without-replacement draw of k ids from [0, C) with O(k)
+    MEMORY — no [C] permutation is ever materialized (the
+    'sparse' participation mode; docs/performance.md "The
+    million-client store"). Sparse Fisher-Yates: draw i picks a rank
+    ``j ~ U[0, C-i)`` among the still-unselected ids and maps it to a
+    client id by walking the already-selected set in ascending order
+    (``v += (v >= s)`` per selected s) — O(k^2 log k) work total,
+    which for per-round cohorts is noise next to the round itself.
+    Same law as ``permutation(rng, C)[:k]``, different stream (the
+    legacy 'perm' mode stays the bitwise-pinned default)."""
+    sentinel = jnp.int32(num_clients)
+
+    def draw(sel, i):
+        j = jax.random.randint(jax.random.fold_in(rng, i), (), 0,
+                               num_clients - i, dtype=jnp.int32)
+        # unfilled slots hold the sentinel C: v < C always, so they
+        # never shift v — the walk only sees real selections
+        v, _ = jax.lax.scan(
+            lambda a, s: (a + (a >= s).astype(jnp.int32), None),
+            j, jnp.sort(sel))
+        return sel.at[i].set(v), v
+
+    _, idx = jax.lax.scan(draw, jnp.full((k,), sentinel, jnp.int32),
+                          jnp.arange(k))
+    return idx
+
+
 def participation_indices(rng: jax.Array, num_clients: int, k: int,
-                          round_idx: jnp.ndarray) -> jnp.ndarray:
+                          round_idx: jnp.ndarray,
+                          mode: str = "perm") -> jnp.ndarray:
     """k online clients, uniformly without replacement
     (misc.py:10-19 permutation sampling); round 0 forces client 0 online
-    by replacing the last slot (main.py:62-63)."""
-    perm = jax.random.permutation(rng, num_clients)
-    idx = perm[:k]
+    by replacing the last slot (main.py:62-63). ``mode`` selects the
+    draw (config.PARTICIPATION_MODES): 'perm' is the legacy O(C log C)
+    full permutation, 'sparse' the O(k)-memory draw for million-client
+    populations — both replayed bit-exactly by the host
+    ``RoundSchedule`` (threefry is backend-deterministic)."""
+    # lint: disable=FTL005 — mode is a static config string
+    if mode == "sparse":
+        idx = _sparse_participation(rng, num_clients, k)
+    else:
+        perm = jax.random.permutation(rng, num_clients)
+        idx = perm[:k]
     has0 = jnp.any(idx == 0)
     force = (round_idx == 0) & ~has0
     return jnp.where(force, idx.at[k - 1].set(0), idx)
@@ -155,6 +193,11 @@ class FederatedTrainer:
         # static online-client count (online_client_rate, misc.py:14)
         self.k_online = max(
             int(cfg.federated.online_client_rate * self.num_clients), 1)
+        # participation draw (config.PARTICIPATION_MODES): 'perm' =
+        # legacy full permutation (bitwise-pinned), 'sparse' = the
+        # O(k)-memory million-client draw; the host RoundSchedule and
+        # the async scheduler replay whichever is set bit-exactly
+        self.participation_mode = cfg.federated.participation_mode
         # deployment-realism round lifecycle (robustness/availability.py,
         # docs/robustness.md "Deployment realism"), sync planes only —
         # the async plane's arrivals come from its event scheduler.
@@ -267,7 +310,21 @@ class FederatedTrainer:
             # round receives only its double-buffered [k, K*B, ...]
             # feed. Client STATE still shards over the mesh as usual —
             # state is params-sized, data is the big thing.
-            self.host_store = HostClientStore(data)
+            if cfg.data.store == "mmap":
+                # disk-backed population (docs/performance.md "The
+                # million-client store"): host residency is O(feed),
+                # the shard files page in on demand
+                store = MmapClientStore(cfg.data.store_dir)
+                if (store.num_clients != self.num_clients
+                        or store.n_max != data.n_max):
+                    raise ValueError(
+                        f"mmap client store at {cfg.data.store_dir!r} "
+                        f"holds [{store.num_clients}, {store.n_max}] "
+                        "clients x rows but the run's data is "
+                        f"[{self.num_clients}, {data.n_max}]")
+                self.host_store = store
+            else:
+                self.host_store = HostClientStore(data)
             self.data = None
             self.val_data = None
         else:
@@ -364,7 +421,8 @@ class FederatedTrainer:
                                 server.round, part_aux)
         if idx is None:
             idx = participation_indices(rng_sample, C, self.k_dispatch,
-                                        server.round)
+                                        server.round,
+                                        mode=self.participation_mode)
         on_sizes = jnp.take(data.sizes, idx)
         rngs = jax.random.split(rng_train, self.k_dispatch)
         batch_mode = self.gather_mode == "batch"
@@ -435,21 +493,25 @@ class FederatedTrainer:
         return self._round_core(
             server, clients, feed.idx, feed.x, feed.y, on_vx, on_vy,
             feed.sizes, on_vsizes, feed.pre_x, feed.pre_y, rng_round,
-            rngs, batch_mode=True, val_batch_mode=False)
+            rngs, batch_mode=self.gather_mode == "batch",
+            val_batch_mode=False,
+            probe=feed if feed.probe_idx is not None else None)
 
     def _round_core(self, server: ServerState, clients: ClientState,
                     idx, on_x, on_y, on_vx, on_vy, on_sizes, on_vsizes,
                     pre_x, pre_y, rng_round, rngs, *, batch_mode: bool,
                     val_batch_mode: bool, data=None, base_params=None,
-                    base_aux=None, weight_scale=None, plan=None):
+                    base_aux=None, weight_scale=None, plan=None,
+                    probe=None):
         """The round program proper, data-plane agnostic: everything
         after the online rows exist — local loops, chaos/guards,
         aggregation, server step, state scatter, metrics. ``on_x`` is
         either the packed rows [k, K*B, ...] (``batch_mode``) or whole
         client shards [k, n_max, ...]. ``data`` (the full store) is
         only threaded for ``post_round_global`` (DRFA's dual phase) —
-        the streaming plane, which gates such algorithms out, passes
-        None.
+        the streaming plane passes None and threads ``probe`` (the
+        feed with its host-packed probe batches) instead, dispatching
+        ``post_round_global_feed``.
 
         COMMIT-DISPATCH SEAM (parallel/round_program.py — the commit
         member of the round-program family): the keyword overrides
@@ -856,9 +918,23 @@ class FederatedTrainer:
             lambda f, n: f.at[idx].set(n), full, new)
         new_clients = scatter(clients, new_on_clients)
 
-        mask_full = jnp.zeros((C,)).at[idx].set(online)
-        loss_full = jnp.zeros((C,)).at[idx].set(losses * online)
-        acc_full = jnp.zeros((C,)).at[idx].set(accs * online)
+        # per-client metric leaves: 'perm' keeps the legacy [C]
+        # scatter; 'sparse' — the million-client mode — emits the
+        # cohort-aligned [k] rows instead. Zero-filling three [C]
+        # vectors per round is the last O(C) term on the round's
+        # critical path (12 MB/round at C=10^6), and every consumer
+        # reduces by sum, which is identical in either layout because
+        # offline rows are zeroed; the cohort ids ride ``cohort_idx``
+        # when the per-client ledger needs them.
+        # lint: disable=FTL005 — participation_mode is a static config
+        if self.participation_mode == "sparse":
+            mask_full = online
+            loss_full = losses * online
+            acc_full = accs * online
+        else:
+            mask_full = jnp.zeros((C,)).at[idx].set(online)
+            loss_full = jnp.zeros((C,)).at[idx].set(losses * online)
+            acc_full = jnp.zeros((C,)).at[idx].set(accs * online)
         comm_bytes = jnp.asarray(
             tree_bytes(server.params) * k
             * alg.payload_scale(), jnp.float32)
@@ -870,9 +946,16 @@ class FederatedTrainer:
         new_server = ServerState(params=new_params, opt=new_opt,
                                  aux=new_saux, round=server.round + 1,
                                  rng=server.rng)
-        # second global phase with data access (DRFA dual update)
-        new_server = alg.post_round_global(
-            new_server, data, jax.random.fold_in(rng_round, 99))
+        # second global phase (DRFA dual update): full data access on
+        # the resident plane; on the stream plane the feed carries the
+        # host-packed probe batches instead (``probe`` — the same
+        # fold_in(rng_round, 99) chain, O(k) device work)
+        if probe is not None:
+            new_server = alg.post_round_global_feed(
+                new_server, probe, jax.random.fold_in(rng_round, 99))
+        else:
+            new_server = alg.post_round_global(
+                new_server, data, jax.random.fold_in(rng_round, 99))
         if self.robust_momentum:
             # re-wrap: the updated norm_bound center rides server.aux
             # through checkpoints and the async snapshot ring unchanged
@@ -1094,6 +1177,16 @@ class FederatedTrainer:
                 jnp.max, out_shardings=replicated_sharding(self.mesh))
         return self._stop_reduce(arr)
 
+    @property
+    def metrics_width(self) -> int:
+        """Leading dim of the per-client RoundMetrics leaves: the full
+        [C] in 'perm' mode, the cohort-aligned [k] in 'sparse' mode
+        (no per-round [C] materialization — the million-client
+        layout). Shape-matching consumers (the supervisor's skipped
+        rounds, history stacking) size off this, not num_clients."""
+        return self.k_online if self.participation_mode == "sparse" \
+            else self.num_clients
+
     def round_scalars_dev(self, clients, metrics) -> dict:
         """DEVICE-side dict of everything the host round loop logs —
         no transfer here, so callers (the CLI loop, the round
@@ -1216,12 +1309,17 @@ class FederatedTrainer:
             # holds it, and a reference back to the trainer would keep
             # a dropped trainer (and its jit caches) alive forever
             mesh = self.mesh
+            alg = self.algorithm
             self._stream = StreamFeedProducer(
                 self.host_store, key_data=key_data,
                 key_impl=jax.random.key_impl(server.rng),
                 start_round=int(round0), num_clients=self.num_clients,
                 k_online=self.k_dispatch, local_steps=self.local_steps,
                 batch_size=self.batch_size, window=window,
+                participation_mode=self.participation_mode,
+                probe_fn=(alg.host_probe_fn(self.host_store.sizes)
+                          if alg.needs_post_probe else None),
+                feed_layout=self.gather_mode,
                 place_fn=lambda t: replicate(t, mesh))
             # leak guard: a trainer dropped WITHOUT invalidate_stream
             # must not orphan the producer thread (it would pin the
@@ -1349,17 +1447,28 @@ class FederatedTrainer:
         a real prefetched feed from the producer."""
         st = self.host_store
         k = self.k_dispatch if k is None else k
-        KB = self.local_steps * self.batch_size
+        # 'batch' layout packs the round's K*B touched rows; 'shard'
+        # (the full-loss feed plan) packs whole padded shards
+        KB = st.n_max if self.gather_mode == "shard" \
+            else self.local_steps * self.batch_size
         sh = replicated_sharding(self.mesh)
         sds = lambda shape, dt: jax.ShapeDtypeStruct(shape, dt,
                                                      sharding=sh)
-        fx, fy = st.x.shape[2:], st.y.shape[2:]
+        fx, fy = st.feat("x"), st.feat("y")
+        dx, dy = st.dtype("x"), st.dtype("y")
+        probe = {}
+        if self.algorithm.needs_post_probe:
+            k2 = self.algorithm.k_online
+            probe = dict(
+                probe_idx=sds((k2,), jnp.int32),
+                probe_x=sds((k2, self.batch_size) + fx, dx),
+                probe_y=sds((k2, self.batch_size) + fy, dy))
         return RoundFeed(
             idx=sds((k,), jnp.int32), sizes=sds((k,), st.sizes.dtype),
-            x=sds((k, KB) + fx, st.x.dtype),
-            y=sds((k, KB) + fy, st.y.dtype),
-            pre_x=sds((k, self.batch_size) + fx, st.x.dtype),
-            pre_y=sds((k, self.batch_size) + fy, st.y.dtype))
+            x=sds((k, KB) + fx, dx),
+            y=sds((k, KB) + fy, dy),
+            pre_x=sds((k, self.batch_size) + fx, dx),
+            pre_y=sds((k, self.batch_size) + fy, dy), **probe)
 
     def _window_struct(self, num_rounds: int) -> RoundFeed:
         """Abstract twin of a packed ``[R, ...]`` feed window — the

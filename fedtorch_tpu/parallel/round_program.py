@@ -194,24 +194,24 @@ def illegal_reason(source: str, dispatch: str, execution: str, *, cfg,
 
     # -- source axis -----------------------------------------------------
     if source == "feed":
-        if algorithm.needs_full_loss:
-            return (f"{algorithm.name} evaluates each client's FULL "
-                    "local dataset every round (gather_mode='shard'); "
-                    "the host feed packs only the round's touched rows")
-        if (type(algorithm).participation
-                is not FedAlgorithm.participation
-                or type(algorithm).post_round_global
-                is not FedAlgorithm.post_round_global):
-            return (f"{algorithm.name} overrides participation/"
-                    "post_round_global with server-state-dependent "
-                    "logic the host feed builder cannot replay")
+        # full-loss algorithms (qFFL) stream via the 'shard' FEED
+        # LAYOUT (whole padded shards packed host-side, rows selected
+        # in-program) — resolve_gather_mode picks it; no refusal.
+        if not algorithm.participation_replayable:
+            return (f"{algorithm.name} samples participation from "
+                    "server state the host feed builder cannot see "
+                    "(DRFA's lambda-distributed draw) — the schedule "
+                    "replay cannot know the cohort before the round")
+        if (type(algorithm).post_round_global
+                is not FedAlgorithm.post_round_global
+                and not algorithm.needs_post_probe):
+            return (f"{algorithm.name} overrides post_round_global "
+                    "with full-data logic and declares no host probe "
+                    "plan (host_probe_fn/post_round_global_feed) the "
+                    "feed builder could pack")
         if algorithm.needs_val_batch or has_val:
             return ("per-client validation splits "
                     "(cfg.federated.personal) are not streamed yet")
-        if gather_mode == "shard":
-            return ("gather_mode='shard' moves whole client shards on "
-                    "device; the feed source packs rows host-side — "
-                    "use gather_mode 'auto' or 'batch'")
 
     # -- execution axis --------------------------------------------------
     if execution == "fused" and dispatch != "commit" \
@@ -472,15 +472,19 @@ def resolve_gather_mode(gather_mode: str, *, algorithm: FedAlgorithm,
     this round (bounds cross-device movement when K*B < shard size);
     'shard' moves whole client shards and indexes per step — required
     when the algorithm reads the full local dataset (qFFL's full loss)
-    and cheaper when a round revisits the shard (K*B >= n_max). The
-    feed source always packs rows host-side, so its plan IS the
-    'batch' layout; refusals (explicit 'shard' on a packed-row
-    program, 'batch' under a full-loss algorithm) are
-    :func:`validate_cell`'s, not this function's."""
+    and cheaper when a round revisits the shard (K*B >= n_max). On
+    the feed source the mode names the FEED LAYOUT: 'batch' packs the
+    round's touched rows host-side (the default — an auto stream
+    resolves 'batch' unless the algorithm needs the full loss, since
+    the pack already moved exactly the touched rows); 'shard' packs
+    whole padded shards and rows are selected in-program, exactly like
+    the device shard gather (qFFL's streamed plan). Refusals ('batch'
+    under a full-loss algorithm) are :func:`validate_cell`'s, not this
+    function's."""
     if gather_mode not in ("auto", "shard", "batch"):
         raise ValueError(f"unknown gather_mode {gather_mode!r}")
     if data_plane == "stream" and gather_mode == "auto":
-        return "batch"
+        return "shard" if algorithm.needs_full_loss else "batch"
     if gather_mode == "auto":
         return "shard" if (algorithm.needs_full_loss
                            or local_steps * batch_size >= n_max) \

@@ -248,11 +248,11 @@ class RoundSupervisor:
         return tree_device_copy(server), tree_device_copy(clients)
 
     def _skip_metrics(self) -> RoundMetrics:
-        # [C] metrics use the REAL client count, matching round_fn's
-        # RoundMetrics shapes (stacking per-round histories must work
-        # across healthy and skipped rounds)
-        C = self.trainer.num_clients
-        z = jnp.zeros((C,))
+        # per-client metrics match round_fn's RoundMetrics shapes
+        # (stacking per-round histories must work across healthy and
+        # skipped rounds): the trainer says whether that is the full
+        # [C] or the sparse mode's cohort-aligned [k]
+        z = jnp.zeros((self.trainer.metrics_width,))
         s = jnp.zeros(())
         return RoundMetrics(train_loss=z, train_acc=z, online_mask=z,
                             comm_bytes=s, dropped_clients=s,

@@ -80,6 +80,10 @@ METRICS_OPTIONAL = {
     "stream_gather_s": "producer schedule+pack wall (total)",
     "stream_h2d_s": "producer device_put dispatch wall (total)",
     "stream_produced": "feeds produced since (re)start",
+    "stream_store_resident_mb": "client-store bytes held in host RAM "
+                                "(mmap store: sizes vector only)",
+    "stream_store_mapped_mb": "client-store bytes memory-mapped from "
+                              "disk (0 for the RAM store)",
     # round-wall critical path (telemetry/critical_path.py;
     # docs/observability.md "Operating and comparing runs")
     "overlap_efficiency": "fraction of this round's producer "

@@ -127,7 +127,9 @@ def compare_runs(dir_a: str, dir_b: str) -> Dict:
     # per-round gauges, mean over the rows that carry them
     for key in ("model_flops_utilization", "hbm_program_peak_bytes",
                 "hbm_live_bytes", "round_device_min_s",
-                "round_host_frac", "stream_depth", "ckpt_queue_depth",
+                "round_host_frac", "stream_depth",
+                "stream_store_resident_mb", "stream_store_mapped_mb",
+                "ckpt_queue_depth",
                 "async_commit_rate", "async_dropouts",
                 "cohort_dispersion", "avail_dropped", "deadline_missed",
                 "quorum_degraded"):

@@ -35,6 +35,23 @@ STREAM_BENCH_ARCH overrides the model (e.g. `mlp` for a CPU-feasible
 full-population capture — the resnet20 default is the on-chip
 `stream` capture-step workload).
 
+THE POPULATION-SCALING ARM (`STREAM_BENCH_POPULATION=1`) replaces the
+plane A/B with the million-client drill (docs/performance.md "The
+million-client store"): for C in {10^3, 10^5, 10^6} it materializes a
+synthetic population to the sharded on-disk store (MmapStoreWriter,
+chunked — the 10^6 population never exists in RAM), runs the stream
+plane with `data.store='mmap'` + `participation_mode='sparse'` at a
+FIXED online cohort k, and records steady round wall, retrace count
+and the store-residency gauges. Acceptance: round wall flat in C
+(10^6 within 10% of 10^3), host residency O(feed) not O(C) (the
+resident gauge holds the sizes vector only while the mapped gauge
+scales with C), bitwise parity mmap-vs-RAM at the common C, zero
+retraces. Writes MILLION_CLIENT_AB.json (MILLION_CLIENT_AB_PATH
+overrides) plus two compare-able run dirs (POPULATION_RUNS_DIR,
+default artifacts/population_ab/{a,b} = smallest/largest C) that the
+`population` capture step gates via `fedtorch-tpu compare --gate
+tests/data/ops_runs/population_gates.json`.
+
 Run:  python scripts/stream_bench.py
 """
 from __future__ import annotations
@@ -261,5 +278,227 @@ def main():
     print(json.dumps(out), flush=True)
 
 
+# -- population-scaling arm (STREAM_BENCH_POPULATION=1) ------------------
+POP_SIZES = (200, 1_000) if SMOKE else (1_000, 100_000, 1_000_000)
+POP_K = 4 if SMOKE else 8          # FIXED cohort: the independent var
+#                                    is C, never the per-round work
+POP_NMAX = 16
+POP_DIM = 16
+POP_BATCH = 8 if SMOKE else 32
+POP_LOCAL = 2 if SMOKE else 40
+POP_ROUNDS = 3 if SMOKE else 12    # timed rounds after the warmup
+POP_SETTLE = 1 if SMOKE else 6     # untimed settling rounds: right
+#                                    after a ~1 GB store write the
+#                                    first rounds pay the kernel's
+#                                    dirty-page writeback + allocator
+#                                    growth on this core — warm past it
+
+
+def _pop_write_store(store_dir: str, C: int, seed: int = 1234):
+    """Materialize the synthetic population chunk-wise — RAM stays
+    O(chunk) however large C gets."""
+    from fedtorch_tpu.data.streaming import MmapStoreWriter
+    rng = np.random.RandomState(seed)
+    writer = MmapStoreWriter(
+        store_dir, n_max=POP_NMAX, x_feat=(POP_DIM,), y_feat=(),
+        x_dtype=np.float32, y_dtype=np.int32)
+    chunk = 65536
+    for lo in range(0, C, chunk):
+        n = min(chunk, C - lo)
+        x = rng.randn(n, POP_NMAX, POP_DIM).astype(np.float32)
+        y = rng.randint(0, 10, (n, POP_NMAX)).astype(np.int32)
+        sizes = rng.randint(1, POP_NMAX + 1, n).astype(np.int32)
+        writer.append(x, y, sizes)
+    return writer.finalize()
+
+
+def _pop_ram_data(C: int, seed: int = 1234):
+    """The SAME population as `_pop_write_store(C, seed)`, held in RAM
+    (identical RandomState stream) — the parity twin."""
+    from fedtorch_tpu.data.batching import ClientData
+    rng = np.random.RandomState(seed)
+    xs, ys, ss = [], [], []
+    chunk = 65536
+    for lo in range(0, C, chunk):
+        n = min(chunk, C - lo)
+        xs.append(rng.randn(n, POP_NMAX, POP_DIM).astype(np.float32))
+        ys.append(rng.randint(0, 10, (n, POP_NMAX)).astype(np.int32))
+        ss.append(rng.randint(1, POP_NMAX + 1, n).astype(np.int32))
+    return ClientData(x=np.concatenate(xs), y=np.concatenate(ys),
+                      sizes=np.concatenate(ss))
+
+
+def _pop_cfg(C: int, store: str, store_dir: str = ""):
+    return ExperimentConfig(
+        data=DataConfig(dataset="synthetic", synthetic_dim=POP_DIM,
+                        batch_size=POP_BATCH, data_plane="stream",
+                        store=store, store_dir=store_dir,
+                        augment=False),
+        federated=FederatedConfig(
+            federated=True, num_clients=C,
+            # rate chosen so max(int(rate*C), 1) == POP_K exactly
+            online_client_rate=(POP_K + 0.5) / C,
+            algorithm="fedavg", sync_type="local_step",
+            participation_mode="sparse"),
+        model=ModelConfig(arch="logistic_regression"),
+        optim=OptimConfig(lr=0.1),
+        train=TrainConfig(local_step=POP_LOCAL),
+        mesh=MeshConfig(),
+    ).finalize()
+
+
+def _pop_run(tr):
+    """Warmup (compile + first feed) then per-round timed steady
+    rounds under the recompilation sentinel. Returns (per-round rows,
+    retraces, gauges, final server params, final client state)."""
+    server, clients = tr.init_state(jax.random.key(0))
+    # warmup: round trace + compile, then the scalar-fetch programs
+    # (shape-specialized to this C — their first call compiles), then
+    # the settling rounds, so the timed window starts from the steady
+    # allocator / page-cache state
+    server, clients, m = tr.run_round(server, clients)
+    sync(server.params)
+    jax.device_get(tr.round_scalars_dev(clients, m))
+    for _ in range(POP_SETTLE):
+        server, clients, m = tr.run_round(server, clients)
+        jax.device_get(tr.round_scalars_dev(clients, m))
+    rows = []
+    with RecompilationSentinel() as sentinel:
+        for r in range(POP_ROUNDS):
+            t0 = time.perf_counter()
+            server, clients, m = tr.run_round(server, clients)
+            sync(server.params)
+            dt = time.perf_counter() - t0
+            # the CLI loop's one batched scalar fetch — never the [C]
+            # metrics leaves
+            sc = jax.device_get(tr.round_scalars_dev(clients, m))
+            n = max(float(sc["n_online"]), 1.0)
+            rows.append({"round": r, "round_s": dt,
+                         "loss": float(sc["loss_sum"]) / n,
+                         "acc": float(sc["acc_sum"]) / n,
+                         "comm_bytes": float(sc["comm_bytes"])})
+    retraces = sum(sentinel.counts.values())
+    gauges = tr.telemetry_gauges()
+    params = jax.device_get(server.params)
+    cstate = jax.device_get(clients)
+    tr.invalidate_stream()
+    return rows, retraces, gauges, params, cstate
+
+
+def _pop_write_run_dir(path: str, rows, meta: dict, gauges: dict):
+    os.makedirs(path, exist_ok=True)
+    keep = {k: v for k, v in gauges.items()
+            if k.startswith("stream_store_")}
+    with open(os.path.join(path, "metrics.jsonl"), "w") as f:
+        f.write(json.dumps({"schema": "fedtorch_tpu.metrics/v1",
+                            "created_unix": time.time(),
+                            "run": meta}) + "\n")
+        for row in rows:
+            f.write(json.dumps(dict(row, **keep)) + "\n")
+
+
+def population_main():
+    import shutil
+    import tempfile
+    devs = jax.devices()
+    log(f"devices: {len(devs)} x {devs[0].platform} (population arm)")
+    runs_dir = os.environ.get("POPULATION_RUNS_DIR") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "artifacts", "population_ab")
+    out = {
+        "platform": f"{len(devs)} x {devs[0].device_kind}",
+        "config": {"populations": list(POP_SIZES), "k_online": POP_K,
+                   "n_max": POP_NMAX, "dim": POP_DIM,
+                   "batch": POP_BATCH, "K": POP_LOCAL,
+                   "rounds_timed": POP_ROUNDS, "smoke": SMOKE,
+                   "store": "mmap",
+                   "participation_mode": "sparse"},
+        "populations": {},
+    }
+    steady = {}
+    for i, C in enumerate(POP_SIZES):
+        gc.collect()
+        store_dir = tempfile.mkdtemp(prefix=f"popstore_{C}_")
+        t0 = time.perf_counter()
+        _pop_write_store(store_dir, C)
+        build_s = time.perf_counter() - t0
+        from fedtorch_tpu.data.streaming import MmapClientStore
+        stub = MmapClientStore(store_dir).as_client_data()
+        cfg = _pop_cfg(C, "mmap", store_dir)
+        tr = FederatedTrainer(cfg, define_model(cfg, POP_BATCH),
+                              make_algorithm(cfg), stub)
+        assert tr.k_online == POP_K, tr.k_online
+        rows, retraces, gauges, params, cstate = _pop_run(tr)
+        del tr
+        # steady mean excludes the first timed round, mirroring
+        # report.summarize's round_s_mean_steady on the run dirs
+        steady[C] = float(np.mean([r["round_s"] for r in rows[1:]]))
+        row = {
+            "clients": C,
+            "store_build_s": round(build_s, 2),
+            "ms_per_round_steady": round(steady[C] * 1e3, 2),
+            "retraces_during_timed_rounds": retraces,
+            "store_resident_mb": round(
+                gauges.get("stream_store_resident_mb", 0.0), 3),
+            "store_mapped_mb": round(
+                gauges.get("stream_store_mapped_mb", 0.0), 3),
+        }
+        if C == POP_SIZES[0]:
+            # parity twin: the SAME population in the RAM store — the
+            # trajectory (server params AND client state) must be
+            # bitwise-identical; only the byte source differs
+            cfg_ram = _pop_cfg(C, "ram")
+            tr2 = FederatedTrainer(cfg_ram,
+                                   define_model(cfg_ram, POP_BATCH),
+                                   make_algorithm(cfg_ram),
+                                   _pop_ram_data(C))
+            _, _, _, params2, cstate2 = _pop_run(tr2)
+            del tr2
+            diffs = [float(np.max(np.abs(np.asarray(a)
+                                         - np.asarray(b))))
+                     if np.asarray(a).size else 0.0
+                     for a, b in zip(jax.tree.leaves((params, cstate)),
+                                     jax.tree.leaves((params2,
+                                                      cstate2)))]
+            row["parity_bitwise_mmap_vs_ram"] = max(diffs) == 0.0
+            row["parity_max_abs_diff"] = max(diffs)
+            out["parity_bitwise_mmap_vs_ram"] = max(diffs) == 0.0
+        meta = {"bench": "population", "clients": C, "store": "mmap",
+                "participation_mode": "sparse", "k_online": POP_K}
+        if i == 0:
+            _pop_write_run_dir(os.path.join(runs_dir, "a"), rows,
+                               meta, gauges)
+        if i == len(POP_SIZES) - 1:
+            _pop_write_run_dir(os.path.join(runs_dir, "b"), rows,
+                               meta, gauges)
+        out["populations"][f"C={C}"] = row
+        log(f"C={C:>9,d}: {steady[C]*1e3:8.2f} ms/round steady, "
+            f"store build {build_s:6.1f}s, resident "
+            f"{row['store_resident_mb']:.3f} MB, mapped "
+            f"{row['store_mapped_mb']:.1f} MB, {retraces} retraces")
+        shutil.rmtree(store_dir, ignore_errors=True)
+    lo, hi = POP_SIZES[0], POP_SIZES[-1]
+    out["round_wall_ratio_max_over_min_pop"] = round(
+        steady[hi] / steady[lo], 3)
+    out["round_wall_flat_within_10pct"] = bool(
+        steady[hi] <= 1.10 * steady[lo])
+    big = out["populations"][f"C={hi}"]
+    out["residency_mapped_not_resident"] = bool(
+        big["store_resident_mb"] < 0.05 * big["store_mapped_mb"])
+    out["zero_retraces"] = all(
+        r["retraces_during_timed_rounds"] == 0
+        for r in out["populations"].values())
+    out["runs_dir"] = runs_dir
+    path = os.environ.get("MILLION_CLIENT_AB_PATH") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "MILLION_CLIENT_AB.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out), flush=True)
+
+
 if __name__ == "__main__":
-    main()
+    if os.environ.get("STREAM_BENCH_POPULATION") == "1":
+        population_main()
+    else:
+        main()

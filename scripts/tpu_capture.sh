@@ -16,6 +16,18 @@
 #   stream           scripts/stream_bench.py      -> STREAM_AB.json
 #                        (device vs stream wall-time + bytes moved +
 #                         residency + retrace count on the real chip)
+#   population       STREAM_BENCH_POPULATION=1 scripts/stream_bench.py
+#                        -> MILLION_CLIENT_AB.json (million-client
+#                         drill: C in {10^3,10^5,10^6} on the mmap
+#                         store + sparse sampling — round wall flat in
+#                         C, residency mapped-not-resident, bitwise
+#                         mmap-vs-RAM parity, 0 retraces) + the
+#                         artifacts/population_ab/{a,b} run dirs,
+#                         gated by compare --gate
+#                         tests/data/ops_runs/population_gates.json
+#                         -> MILLION_CLIENT_COMPARE.json
+#                         (docs/performance.md "The million-client
+#                         store")
 #   async            scripts/async_bench.py       -> ASYNC_AB.json
 #                        (sync round clock vs FedBuff-style commit
 #                         clock under the straggler-heavy schedule +
@@ -131,9 +143,9 @@ TRIES="${TPU_CAPTURE_WAIT_TRIES:-90}"   # ~6 h of patience by default
 # the relay wedges mid-list
 # audit rides early: it is seconds of abstract lowering and proves the
 # program invariants on the real backend before the long benches run
-DEFAULT_STEPS="audit concurrency mfu stream builder-matrix avail async attack \
-host-chaos cohort telemetry compare bench-streaming bench-dispatch \
-bench-unroll bench zoo pallas flash-train vmap baseline"
+DEFAULT_STEPS="audit concurrency mfu stream population builder-matrix avail \
+async attack host-chaos cohort telemetry compare bench-streaming \
+bench-dispatch bench-unroll bench zoo pallas flash-train vmap baseline"
 STEPS="${*:-$DEFAULT_STEPS}"
 
 echo "[tpu_capture] waiting for the relay (up to ${TRIES}x120s probes)"
@@ -151,6 +163,13 @@ for step in $STEPS; do
         bench-dispatch) run env BENCH_SINGLE_DISPATCH=0 python bench.py ;;
         bench-streaming) run env BENCH_STREAMING=1 python bench.py ;;
         stream)         run python scripts/stream_bench.py ;;
+        population)     run env STREAM_BENCH_POPULATION=1 \
+                            python scripts/stream_bench.py
+                        run python -m fedtorch_tpu.tools.compare \
+                            artifacts/population_ab/a \
+                            artifacts/population_ab/b \
+                            --gate tests/data/ops_runs/population_gates.json \
+                            --out MILLION_CLIENT_COMPARE.json ;;
         async)          run python scripts/async_bench.py ;;
         attack)         run python scripts/chaos_suite.py \
                             --attack-matrix --rounds 25 \

@@ -245,8 +245,12 @@ def test_scan_cell_refused_on_async_at_call_time():
 
 
 @pytest.mark.parametrize("source,dispatch,algorithm,fed_kw,match", [
-    ("feed", "round", "qffl", {"qffl_q": 1.0}, "FULL local dataset"),
-    ("feed", "round", "fedavg", {"drfa": True}, "participation"),
+    # qFFL (shard feed layout) and default-uniform DRFA (host probe
+    # plan) now RUN on the feed source — the remaining feed refusal is
+    # the lambda-DISTRIBUTED draw, which reads device state (the dual
+    # variable) the host feed builder cannot see
+    ("feed", "round", "fedavg",
+     {"drfa": True, "drfa_lambda_sampling": True}, "participation"),
     ("resident", "commit", "qsparse", {},
      "sync_mode='async' is unsupported"),
     ("feed", "commit", "afl", {},

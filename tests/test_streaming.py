@@ -272,7 +272,8 @@ def test_feed_packer_native_equals_numpy(monkeypatch, order):
 def test_feed_nbytes_counts_all_leaves():
     store = _toy_store()
     feed = store.pack(np.asarray([0, 1]), np.zeros((2, 4), np.int64), 2)
-    expected = sum(np.asarray(leaf).nbytes for leaf in feed)
+    expected = sum(np.asarray(leaf).nbytes for leaf in feed
+                   if leaf is not None)  # probe leaves unused here
     assert feed_nbytes(feed) == expected
 
 
@@ -346,24 +347,64 @@ def test_run_rounds_scans_on_stream_plane():
     t_str.invalidate_stream()
 
 
-def test_explicit_shard_gather_refused():
-    cfg = make_cfg("stream")
-    data = build_federated_data(cfg)
-    model = define_model(cfg, batch_size=cfg.data.batch_size)
-    with pytest.raises(ValueError, match="shard"):
-        FederatedTrainer(cfg, model, make_algorithm(cfg), data.train,
-                         gather_mode="shard")
+def test_explicit_shard_gather_streams_full_shards():
+    """Explicit gather_mode='shard' on the stream plane is a FEED
+    LAYOUT now (ISSUE 18 gate lift): the producer packs whole
+    [k, n_max, ...] client shards and the trajectory matches the
+    device shard program bitwise (same epoch_permutation row order)."""
+    cfg_d = make_cfg("device")
+    cfg_s = make_cfg("stream")
+    data = build_federated_data(cfg_d)
+    model = define_model(cfg_d, batch_size=cfg_d.data.batch_size)
+    t_dev = FederatedTrainer(cfg_d, model, make_algorithm(cfg_d),
+                             data.train, gather_mode="shard")
+    t_str = FederatedTrainer(cfg_s, define_model(
+        cfg_s, batch_size=cfg_s.data.batch_size),
+        make_algorithm(cfg_s), data.train, gather_mode="shard")
+    assert t_str.gather_mode == "shard"
+    s1, c1 = t_dev.init_state(jax.random.key(2))
+    s2, c2 = t_str.init_state(jax.random.key(2))
+    for _ in range(2):
+        s1, c1, m1 = t_dev.run_round(s1, c1)
+        s2, c2, m2 = t_str.run_round(s2, c2)
+    assert_trees_equal((s1.params, s1.aux, c1), (s2.params, s2.aux, c2))
+    assert_trees_equal(m1, m2)
+    t_str.invalidate_stream()
 
 
-@pytest.mark.parametrize("algorithm,kw,match", [
-    ("qffl", {"qffl_q": 1.0}, "FULL local dataset"),
-    ("fedavg", {"drfa": True}, "participation"),
+@pytest.mark.parametrize("algorithm,kw", [
+    # lifted gates (ISSUE 18): qFFL's full-shard loss streams via the
+    # 'shard' feed layout; default-uniform DRFA's dual phase streams
+    # via the host probe plan — both must match the device plane
+    # BITWISE (DRFA: including the lambda trajectory in server aux)
+    ("qffl", {"qffl_q": 1.0}),
+    ("fedavg", {"drfa": True}),
 ])
-def test_unsupported_algorithms_raise(algorithm, kw, match):
-    cfg = make_cfg("stream", algorithm=algorithm, **kw)
+def test_lifted_algorithms_stream_bitwise(algorithm, kw):
+    t_dev = build("device", algorithm=algorithm, **kw)
+    t_str = build("stream", algorithm=algorithm, **kw)
+    s1, c1 = t_dev.init_state(jax.random.key(4))
+    s2, c2 = t_str.init_state(jax.random.key(4))
+    with RecompilationSentinel() as sentinel:
+        for _ in range(3):
+            s1, c1, m1 = t_dev.run_round(s1, c1)
+            s2, c2, m2 = t_str.run_round(s2, c2)
+        jax.block_until_ready(s2.params)
+    assert_trees_equal((s1.params, s1.aux, c1), (s2.params, s2.aux, c2))
+    assert_trees_equal(m1, m2)
+    # trace-once holds for the lifted algorithms' streamed programs
+    sentinel.assert_traces(t_str.stream_trace_name, expected=1)
+    t_str.invalidate_stream()
+
+
+def test_drfa_lambda_sampling_still_refused_on_stream():
+    """The remaining DRFA feed refusal: the lambda-DISTRIBUTED draw
+    reads device state (the dual variable) the host feed builder
+    cannot see."""
+    cfg = make_cfg("stream", drfa=True, drfa_lambda_sampling=True)
     data = build_federated_data(cfg)
     model = define_model(cfg, batch_size=cfg.data.batch_size)
-    with pytest.raises(ValueError, match=match):
+    with pytest.raises(ValueError, match="participation"):
         FederatedTrainer(cfg, model, make_algorithm(cfg), data.train)
 
 
