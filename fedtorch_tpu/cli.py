@@ -402,6 +402,26 @@ def build_parser() -> argparse.ArgumentParser:
                         "renormalized partial cohort (counted + "
                         "evented); 'abort' escalates to the supervisor "
                         "retry/skip path (requires --supervisor)")
+    p.add_argument("--dp_noise_multiplier", type=float, default=0.0,
+                   help="> 0 arms DP-FedAvg server aggregation: "
+                        "per-client L2 clip to --dp_clip_norm then "
+                        "Gaussian noise z*clip/k on the weighted "
+                        "estimate (0 = off, program byte-identical)")
+    p.add_argument("--dp_clip_norm", type=float, default=1.0,
+                   help="per-client L2 clip radius for the DP stage")
+    p.add_argument("--dp_epsilon_budget", type=float, default=0.0,
+                   help="> 0 caps the RDP-accounted epsilon spend at "
+                        "--dp_delta; exhaustion handled per "
+                        "--dp_budget_action (0 = unlimited; spend is "
+                        "still accounted and logged)")
+    p.add_argument("--dp_delta", type=float, default=1e-5,
+                   help="target delta for the (eps, delta) accounting")
+    p.add_argument("--dp_budget_action", default="stop",
+                   choices=("stop", "degrade"),
+                   help="epsilon-budget exhaustion: 'stop' ends the "
+                        "run cleanly at the last affordable round; "
+                        "'degrade' continues noise-free (counted + "
+                        "evented, health intent 'degraded')")
     # device / mesh (replaces parameters.py:225-236 MPI block)
     p.add_argument("--backend", default=None,
                    help="jax platform: tpu|cpu|None(auto)")
@@ -645,7 +665,12 @@ def args_to_config(args) -> ExperimentConfig:
             avail_diurnal_period=args.avail_diurnal_period,
             over_select_frac=args.over_select_frac,
             avail_quorum_frac=args.avail_quorum_frac,
-            avail_quorum_action=args.avail_quorum_action),
+            avail_quorum_action=args.avail_quorum_action,
+            dp_noise_multiplier=args.dp_noise_multiplier,
+            dp_clip_norm=args.dp_clip_norm,
+            dp_epsilon_budget=args.dp_epsilon_budget,
+            dp_delta=args.dp_delta,
+            dp_budget_action=args.dp_budget_action),
         experiment=args.experiment,
     )
     return cfg.finalize()
@@ -906,6 +931,35 @@ def run_experiment(cfg: ExperimentConfig,
             )
             anomaly = EwmaAnomalyDetector(
                 zscore=cfg.telemetry.anomaly_zscore)
+        # privacy plane (robustness/privacy.py): the host-side RDP
+        # accountant streams epsilon spend per committed round. EVERY
+        # process accounts (the charge is deterministic, so budget
+        # decisions stay SPMD-consistent without a collective); only
+        # the writer persists. Participation probability is the run's
+        # real cohort width over the population — the commit buffer m
+        # on the async plane, k_online on the sync planes ('sparse'
+        # k/C directly; 'perm' prefix selection charges equivalently).
+        accountant = None
+        dp_q = 0.0
+        if cfg.fault.dp_armed:
+            from fedtorch_tpu.robustness.privacy import (
+                ACCOUNTANT_FILE, PrivacyAccountant,
+            )
+            accountant = PrivacyAccountant(
+                cfg.fault.dp_noise_multiplier, cfg.fault.dp_delta)
+            width = getattr(trainer, "buffer_size", None) \
+                or trainer.k_online
+            dp_q = min(1.0, width / float(cfg.federated.num_clients))
+            if accountant.load_existing(ckpt_dir):
+                # elastic restarts ADOPT the run dir's accountant (the
+                # program_costs.json convention) — spend resumes, and
+                # per-round-index dedup below makes re-run rounds
+                # charge exactly once
+                logger.log(
+                    "privacy accountant: adopted existing "
+                    f"{ACCOUNTANT_FILE} (eps_spent="
+                    f"{accountant.epsilon():.4f} over "
+                    f"{accountant.charged_rounds} rounds)")
         # still inside the guard: this fetch can raise too (device
         # fault, poisoned resume state) and must not leak the active
         # telemetry / a 'starting' intent for a dead run
@@ -924,6 +978,9 @@ def run_experiment(cfg: ExperimentConfig,
     # consecutive sub-quorum rounds (availability lifecycle): a
     # persistent streak flips the health intent to 'degraded' below
     quorum_streak = 0
+    # privacy budget lifecycle: True once 'degrade' flipped the run
+    # noise-free — drives the 'degraded' health intent at exit
+    dp_degraded = False
     # round-wall critical path (telemetry/critical_path.py): per-round
     # overlap efficiency from the DELTAS of the producer's cumulative
     # gather/H2D/wait gauges — pure host float math over values the
@@ -934,6 +991,35 @@ def run_experiment(cfg: ExperimentConfig,
     overlap_tracker = StreamOverlapTracker()
     try:
         for r in range(start_round, cfg.federated.num_comms):
+            # privacy budget lifecycle (docs/robustness.md "Privacy
+            # plane"): pre-check affordability BEFORE dispatching
+            # round r — 'stop' ends the run at the LAST affordable
+            # round (never one past the budget), 'degrade' flips the
+            # traced noise scale to 0.0 (data, not program: no
+            # retrace) and keeps going noise-free. Deterministic on
+            # every process, so the SPMD decision needs no collective.
+            if accountant is not None and not dp_degraded \
+                    and cfg.fault.dp_epsilon_budget > 0.0 \
+                    and accountant.preview_epsilon(dp_q) \
+                    > cfg.fault.dp_epsilon_budget:
+                action = cfg.fault.dp_budget_action
+                spent = accountant.epsilon()
+                tel.event("privacy.budget_exhausted", round=r,
+                          action=action, epsilon_spent=spent,
+                          epsilon_budget=cfg.fault.dp_epsilon_budget,
+                          delta=cfg.fault.dp_delta,
+                          charged_rounds=accountant.charged_rounds)
+                logger.log(
+                    f"privacy budget exhausted before round {r}: "
+                    f"eps_spent={spent:.4f} of "
+                    f"{cfg.fault.dp_epsilon_budget} (action="
+                    f"{action})")
+                results["dp_exhausted"] = True
+                results["dp_exhausted_at_round"] = r
+                if action == "stop":
+                    break
+                server = trainer.dp_set_noise_scale(server, 0.0)
+                dp_degraded = True
             timer.new_round()
             # copy, not alias: the round jit donates the server buffers
             prev_params = jax.tree.map(jnp.copy, server.params) \
@@ -980,6 +1066,12 @@ def run_experiment(cfg: ExperimentConfig,
             # the scalar fetch blocked on the round's results: the
             # round genuinely completed — feed the stall watchdog
             watchdog.heartbeat(r)
+            if accountant is not None and not dp_degraded:
+                # charge the COMMITTED round (after degrade the noise
+                # is off, so spend freezes); charge_round dedups by
+                # round index — supervisor retries and resume re-runs
+                # charge exactly once
+                accountant.charge_round(r, dp_q)
 
             if cost_capture is not None and not cost_capture.captured \
                     and not cost_capture.load_existing():
@@ -1084,6 +1176,12 @@ def run_experiment(cfg: ExperimentConfig,
                         fed_data.test_y, num_classes_of(cfg.data.dataset))
                     logger.log("Round: {}. Per-class acc: {}".format(
                         r, [round(float(a), 4) for a in accs]))
+                if accountant is not None and tel.is_writer:
+                    # persist spend BEFORE the checkpoint that could
+                    # become a resume point: any adopted restart then
+                    # sees spend >= its round (never-forget-spend half
+                    # of the resume contract)
+                    accountant.save(ckpt_dir)
                 timer.start("checkpoint")
                 with tel.span("checkpoint", round=r):
                     saver(ckpt_dir, server, clients, cfg, best_prec1,
@@ -1143,6 +1241,13 @@ def run_experiment(cfg: ExperimentConfig,
                 # the heterogeneity gauge (cohort_stats on) — already
                 # part of the batched scalar fetch
                 row["cohort_dispersion"] = sc["cohort_dispersion"]
+            if "dp_clipped_frac" in sc:
+                # privacy-plane gauges (DP armed) — same batched fetch
+                row["dp_clipped_frac"] = sc["dp_clipped_frac"]
+                row["dp_noise_sigma"] = sc["dp_noise_sigma"]
+            if accountant is not None:
+                # host-side accountant read: pure f64 math, no sync
+                row["dp_epsilon_spent"] = accountant.epsilon()
             if led is not None:
                 # cohort norm quantiles + the per-client ledger fold
                 # (host numpy from the same fetch; O(k) update)
@@ -1224,11 +1329,12 @@ def run_experiment(cfg: ExperimentConfig,
             host_retries_now = recovery.total_retries()
             quorum_streak = quorum_streak + 1 \
                 if sc["quorum_degraded"] > 0 else 0
-            if recovery.degraded or quorum_streak >= 3:
+            if recovery.degraded or quorum_streak >= 3 or dp_degraded:
                 # host seam running degraded, OR the availability
                 # lifecycle committing sub-quorum cohorts for 3+
-                # consecutive rounds — progressing, but an operator
-                # should look (docs/robustness.md "Deployment realism")
+                # consecutive rounds, OR the privacy budget exhausted
+                # into noise-free continuation — progressing, but an
+                # operator should look (docs/robustness.md)
                 intent = "degraded"
             elif host_retries_now > host_retries_seen:
                 intent = "recovering"
@@ -1354,6 +1460,10 @@ def run_experiment(cfg: ExperimentConfig,
                                 for k, v in sorted(final_hist.items())})
             if ledger is not None:
                 ledger.flush()
+            if accountant is not None and tel.is_writer:
+                # final durable spend (save absorbs I/O failure — the
+                # bookkeeping never masks the loop's outcome)
+                accountant.save(ckpt_dir)
             if anomaly is not None:
                 tel.event("anomaly.summary", fields=anomaly.summary())
             tel.event("run.end",
@@ -1363,17 +1473,27 @@ def run_experiment(cfg: ExperimentConfig,
                 tel.health_update("error")
             elif results.get("preempted"):
                 tel.health_update("preempted")
-            elif quorum_streak >= 3:
+            elif quorum_streak >= 3 or dp_degraded:
                 # the run finished, but its tail was a persistent
-                # sub-quorum streak (availability lifecycle committing
-                # degraded cohorts) — keep the operator signal instead
-                # of overwriting it with a clean 'complete'
+                # sub-quorum streak OR a noise-free privacy 'degrade'
+                # continuation — keep the operator signal instead of
+                # overwriting it with a clean 'complete'. (A budget
+                # 'stop' lands in the else: ending at the last
+                # affordable round IS the clean outcome.)
                 tel.health_update("degraded")
             else:
                 tel.health_update("complete")
             _uninstall_host_plane()
             tel.close()
     results["best_top1"] = best_prec1
+    if accountant is not None:
+        results["dp"] = {
+            "epsilon_spent": accountant.epsilon(),
+            "delta": cfg.fault.dp_delta,
+            "charged_rounds": accountant.charged_rounds,
+            "exhausted": bool(results.get("dp_exhausted")),
+            "degraded": dp_degraded,
+        }
     if supervisor is not None:
         st = supervisor.stats
         results["supervisor"] = {

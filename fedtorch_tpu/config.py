@@ -38,6 +38,12 @@ BYZANTINE_MODES = ("sign_flip", "scale", "zero", "gauss", "collude")
 # qFFL's fairness scalar, DRFA's nested wrapper) have no single tree
 # the momentum can live against, so they raise at construction.
 NORM_BOUND_ALGORITHMS = ("fedavg", "fedprox", "fedadam")
+# The DP stage (robustness/privacy.py) clips each client's single
+# params-shaped update payload radially; the same structured-payload
+# algorithms that can't host norm_bound's momentum have no single
+# tree a fixed-radius clip is meaningful against, so DP refuses them
+# by name at finalize too.
+DP_ALGORITHMS = ("fedavg", "fedprox", "fedadam")
 
 # Named host-plane fault seams (robustness/host_chaos.py;
 # docs/robustness.md "Host plane"). Each names one host-side I/O or
@@ -547,6 +553,30 @@ class FaultConfig:
     # rollback/retry path ('abort'; requires fault.supervisor)
     avail_quorum_frac: float = 0.0
     avail_quorum_action: str = "degrade"  # 'degrade' | 'abort'
+    # -- privacy plane (robustness/privacy.py) --------------------------
+    # > 0 arms server-side DP-FedAvg aggregation: per-client L2 clip to
+    # dp_clip_norm, then Gaussian noise at stddev
+    # dp_noise_multiplier * dp_clip_norm / cohort_k on the weighted
+    # estimate, drawn from fold_in(rng_round, DP_SALT). 0 (default) =
+    # off: zero extra pytree leaves, round program HLO byte-identical.
+    dp_noise_multiplier: float = 0.0
+    dp_clip_norm: float = 1.0
+    # > 0 arms the epsilon-budget lifecycle: the host-side RDP
+    # accountant pre-checks affordability every round and, at
+    # exhaustion, either ends the run cleanly at the last affordable
+    # round ('stop' -> privacy.budget_exhausted event + 'complete'
+    # intent) or continues noise-free ('degrade' -> 'degraded' intent,
+    # counted + evented, never wedging). 0 = unlimited budget (the
+    # accountant still streams epsilon_spent).
+    dp_epsilon_budget: float = 0.0
+    dp_delta: float = 1e-5
+    dp_budget_action: str = "stop"  # 'stop' | 'degrade'
+
+    @property
+    def dp_armed(self) -> bool:
+        """True when the DP aggregation stage is traced into the round
+        program; disarmed programs stay byte-identical."""
+        return self.dp_noise_multiplier > 0.0
 
     @property
     def avail_armed(self) -> bool:
@@ -936,6 +966,48 @@ class ExperimentConfig:
                 "rounds into the round supervisor's rollback/retry "
                 "path — arm fault.supervisor (or use 'degrade', which "
                 "commits the renormalized partial cohort)")
+        if flt.dp_noise_multiplier < 0.0:
+            raise ValueError(
+                "fault.dp_noise_multiplier must be >= 0 (0 = DP off), "
+                f"got {flt.dp_noise_multiplier}")
+        if flt.dp_armed and flt.dp_clip_norm <= 0.0:
+            raise ValueError(
+                "fault.dp_clip_norm must be > 0 when DP is armed, got "
+                f"{flt.dp_clip_norm}")
+        if flt.dp_armed and not 0.0 < flt.dp_delta < 1.0:
+            raise ValueError(
+                "fault.dp_delta must be in (0, 1) when DP is armed, "
+                f"got {flt.dp_delta}")
+        if flt.dp_budget_action not in ("stop", "degrade"):
+            raise ValueError(
+                "fault.dp_budget_action must be 'stop' or 'degrade', "
+                f"got {flt.dp_budget_action!r}")
+        if flt.dp_epsilon_budget < 0.0:
+            raise ValueError(
+                "fault.dp_epsilon_budget must be >= 0 (0 = unlimited), "
+                f"got {flt.dp_epsilon_budget}")
+        if flt.dp_epsilon_budget > 0.0 and not flt.dp_armed:
+            raise ValueError(
+                "fault.dp_epsilon_budget > 0 without "
+                "fault.dp_noise_multiplier > 0: there is no DP "
+                "mechanism to budget — arm DP or drop the budget")
+        if flt.dp_armed and flt.robust_agg == "norm_bound":
+            raise ValueError(
+                "fault.dp_noise_multiplier with "
+                "fault.robust_agg='norm_bound' double-clips: norm_bound "
+                "already radially clips every client toward the server "
+                "momentum at a data-dependent radius, which breaks the "
+                "fixed-sensitivity bound the DP clip certifies — use a "
+                "non-clipping robust rule (trimmed_mean, median, krum) "
+                "under DP")
+        if flt.dp_armed and fed.federated \
+                and self.effective_algorithm not in DP_ALGORITHMS:
+            raise ValueError(
+                "fault.dp_noise_multiplier clips and noises a single "
+                "params-shaped payload tree; algorithm "
+                f"{self.effective_algorithm!r} ships a structured "
+                "payload the fixed-radius clip is not meaningful "
+                f"against (supported: {', '.join(DP_ALGORITHMS)})")
         if fed.sync_mode == "async" and flt.straggler_rate > 0.0 \
                 and flt.avail_model == "default" and not flt.avail_armed:
             warnings.warn(

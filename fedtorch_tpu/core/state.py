@@ -97,6 +97,12 @@ class RoundMetrics(NamedTuple):
     cohort_staleness: Any = None   # [k] commit staleness (0 on sync)
     cohort_norm_q: Any = None      # [5] update-norm quantiles
     cohort_dispersion: Any = None  # scalar 1 - mean cos(u_i, mean)
+    # privacy plane (robustness/privacy.py; docs/robustness.md
+    # "Privacy plane"). None (default) contributes ZERO pytree leaves
+    # — DP off keeps the round program HLO byte-identical.
+    dp_clipped_frac: Any = None    # scalar [0,1] — accepted clients clipped
+    dp_noise_sigma: Any = None     # scalar — applied noise stddev
+    #                                (sigma * noise_scale; 0 after degrade)
 
 
 def tree_where(pred, on_true, on_false):

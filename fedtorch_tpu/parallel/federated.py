@@ -104,6 +104,9 @@ from fedtorch_tpu.robustness.availability import sync_lifecycle
 from fedtorch_tpu.robustness.guards import (
     renormalize_accepted, screen_payloads,
 )
+from fedtorch_tpu.robustness.privacy import (
+    dp_add_noise, dp_clip_payloads, dp_noise_stddev,
+)
 from fedtorch_tpu.utils.tracing import instrument_trace
 
 
@@ -246,6 +249,19 @@ class FederatedTrainer:
         # per-client masks/suspicion + the heterogeneity gauges and
         # they ride the loop's one batched fetch into the ledger
         self.cohort_stats = bool(cfg.telemetry.cohort_stats)
+        # privacy plane (robustness/privacy.py): static config — off
+        # (default) the round program is HLO byte-identical (no wrap,
+        # no extra RoundMetrics outputs); on, server.aux is wrapped
+        # {'alg': <aux>, 'dp_noise_scale': f32[]} by init_state (DP x
+        # norm_bound is refused at finalize, so the two wraps never
+        # coexist; the async ring still wraps OUTSIDE) and _round_core
+        # clips each client to dp_clip_norm before the robust rule and
+        # noises the released estimate after it. dp_noise_scale is
+        # DATA (1.0 armed, 0.0 after a budget 'degrade') so exhaustion
+        # never retraces.
+        self.dp_on = bool(cfg.fault.dp_armed)
+        self.dp_clip_norm = float(cfg.fault.dp_clip_norm)
+        self.dp_noise_multiplier = float(cfg.fault.dp_noise_multiplier)
 
         # data source + gather mode: the refusals (explicit 'shard' on
         # a packed-row program, feed-source algorithm preconditions,
@@ -396,6 +412,13 @@ class FederatedTrainer:
             server = server._replace(aux={
                 "alg": server.aux,
                 "norm_bound_m": tree_zeros_like(params)})
+        if self.dp_on:
+            # noise_scale is DATA: the budget lifecycle's 'degrade'
+            # flips it to 0.0 in place (dp_set_noise_scale) — same
+            # program, no retrace
+            server = server._replace(aux={
+                "alg": server.aux,
+                "dp_noise_scale": jnp.asarray(1.0, jnp.float32)})
         return replicate(server, self.mesh), \
             shard_clients(clients, self.mesh)
 
@@ -415,8 +438,8 @@ class FederatedTrainer:
 
         # participation hooks read the ALGORITHM aux (DRFA's lambda),
         # not the norm_bound momentum wrap
-        part_aux = server.aux["alg"] if self.robust_momentum \
-            else server.aux
+        part_aux = server.aux["alg"] \
+            if (self.robust_momentum or self.dp_on) else server.aux
         idx = alg.participation(rng_sample, C, self.k_dispatch,
                                 server.round, part_aux)
         if idx is None:
@@ -537,6 +560,16 @@ class FederatedTrainer:
                 base_aux = base_aux["alg"]
         else:
             robust_m = None
+        # the DP wrap ({'alg': ..., 'dp_noise_scale': f32[]}) unwraps
+        # at the same seam (DP x norm_bound refused at finalize, so
+        # at most one wrap is present under the async ring)
+        if self.dp_on:
+            dp_scale = server.aux["dp_noise_scale"]
+            server = server._replace(aux=server.aux["alg"])
+            if base_aux is not None:
+                base_aux = base_aux["alg"]
+        else:
+            dp_scale = None
         cfg, model, alg = self.cfg, self.model, self.algorithm
         K, B, C = self.local_steps, self.batch_size, self.num_clients
         # the online axis length: k_online for the sync planes, the
@@ -814,6 +847,18 @@ class FederatedTrainer:
             # never delivered its crafted upload
             byz_count = jnp.sum(plan.byzantine * survive)
 
+        # privacy plane, clip half (robustness/privacy.py): per-client
+        # L2 clip to dp_clip_norm BEFORE the robust rule sees the
+        # payloads — the clip bounds every client's sensitivity no
+        # matter what the rule (or the cohort statistics below) then
+        # does with them. Composition order (pinned, docs/robustness.md
+        # "Privacy plane"): accept mask -> DP clip -> robust rule
+        # (x staleness weights) -> DP noise on the released estimate.
+        dp_clipped_frac = None
+        if self.dp_on:
+            payloads, dp_clipped_frac = dp_clip_payloads(
+                payloads, weights, accept, self.dp_clip_norm)
+
         # the aggregation seam: either the plain weighted sum (the
         # pre-robust engine, kept verbatim so --robust_agg mean stays
         # bitwise-identical) or a byzantine-robust rule over the same
@@ -861,6 +906,23 @@ class FederatedTrainer:
                           "susp": cs.suspicion,
                           "norm_q": cs.norm_q, "disp": cs.dispersion}
         payload_sum = alg.aggregate_transform(payload_sum)
+
+        # privacy plane, noise half: calibrated Gaussian noise on the
+        # RELEASED estimate — sigma = z * clip / cohort_k on the
+        # weighted mean (DP-FedAvg server noise), drawn from its own
+        # fold of the round key so every other stream is untouched.
+        # cohort_k is the round's real width: k_online on the sync
+        # planes (over-selection closes on k_online), the commit
+        # buffer size m on the async plane (base_params is only
+        # threaded by the commit dispatch).
+        dp_sigma_t = None
+        if self.dp_on:
+            dp_k = k if base_params is not None else self.k_online
+            dp_sigma = dp_noise_stddev(self.dp_noise_multiplier,
+                                       self.dp_clip_norm, dp_k)
+            payload_sum = dp_add_noise(payload_sum, rng_round, weights,
+                                       dp_sigma, dp_scale)
+            dp_sigma_t = (dp_sigma * dp_scale).astype(jnp.float32)
 
         new_params, new_opt, new_saux = alg.server_update(
             server.params, server.opt, server.aux, payload_sum,
@@ -961,6 +1023,12 @@ class FederatedTrainer:
             # through checkpoints and the async snapshot ring unchanged
             new_server = new_server._replace(aux={
                 "alg": new_server.aux, "norm_bound_m": new_robust_m})
+        if self.dp_on:
+            # re-wrap: the live noise scale rides server.aux through
+            # checkpoints and the snapshot ring unchanged (degrade
+            # flips the HOST copy; the program passes it through)
+            new_server = new_server._replace(aux={
+                "alg": new_server.aux, "dp_noise_scale": dp_scale})
         # federation-plane cohort fields (telemetry.cohort_stats):
         # per-online-client evidence + heterogeneity gauges. The
         # staleness vector is the sync plane's zeros here; the commit
@@ -1000,6 +1068,12 @@ class FederatedTrainer:
                 avail_dropped=jnp.sum(avail_drop.astype(jnp.float32)),
                 deadline_missed=jnp.sum(avail_miss.astype(jnp.float32)),
                 quorum_degraded=q_flag)
+        # privacy-plane gauges (None = DP off: zero extra outputs)
+        dp_fields = {}
+        if self.dp_on:
+            dp_fields = dict(
+                dp_clipped_frac=dp_clipped_frac.astype(jnp.float32),
+                dp_noise_sigma=dp_sigma_t)
         metrics = RoundMetrics(
             train_loss=loss_full, train_acc=acc_full,
             online_mask=mask_full, comm_bytes=comm_bytes,
@@ -1011,7 +1085,7 @@ class FederatedTrainer:
             byzantine_clients=jnp.asarray(byz_count, jnp.float32),
             robust_selected=jnp.asarray(robust_selected, jnp.float32),
             robust_trimmed=jnp.asarray(robust_trimmed, jnp.float32),
-            **avail_fields, **cohort_fields)
+            **avail_fields, **cohort_fields, **dp_fields)
         return new_server, new_clients, metrics
 
     # -- fused client round (cfg.mesh.client_fusion='fused') --------------
@@ -1187,6 +1261,30 @@ class FederatedTrainer:
         return self.k_online if self.participation_mode == "sparse" \
             else self.num_clients
 
+    def dp_set_noise_scale(self, server: ServerState,
+                           value: float) -> ServerState:
+        """Host-side setter for the traced DP noise scale (the budget
+        lifecycle's 'degrade': flip to 0.0 and the armed program keeps
+        running noise-free). Replaces the aux leaf with a device array
+        of the SAME aval and sharding — data changes, the program does
+        not, so there is no retrace. Handles the async ring wrapping
+        outside the dp wrap."""
+        if not self.dp_on:
+            raise ValueError(
+                "dp_set_noise_scale on a trainer without DP armed "
+                "(fault.dp_noise_multiplier == 0)")
+        aux = server.aux
+        ring = None
+        if isinstance(aux, dict) and "ring" in aux:
+            ring, aux = aux["ring"], aux["alg"]
+        leaf = aux["dp_noise_scale"]
+        new_leaf = jax.device_put(
+            jnp.asarray(value, jnp.float32), leaf.sharding)
+        aux = dict(aux, dp_noise_scale=new_leaf)
+        if ring is not None:
+            aux = {"alg": aux, "ring": ring}
+        return server._replace(aux=aux)
+
     def round_scalars_dev(self, clients, metrics) -> dict:
         """DEVICE-side dict of everything the host round loop logs —
         no transfer here, so callers (the CLI loop, the round
@@ -1227,6 +1325,12 @@ class FederatedTrainer:
             # the heterogeneity gauge (telemetry.cohort_stats) rides
             # the same fetch; absent — not 0 — when stats are off
             out["cohort_dispersion"] = metrics.cohort_dispersion
+        if metrics.dp_clipped_frac is not None:
+            # privacy-plane gauges (fault.dp_noise_multiplier > 0):
+            # clip saturation + applied noise stddev, same fetch;
+            # absent — not 0 — when DP is off
+            out["dp_clipped_frac"] = metrics.dp_clipped_frac
+            out["dp_noise_sigma"] = metrics.dp_noise_sigma
         if self._stop_signal is not None:
             out["stop"] = self.stop_flag_dev(bool(self._stop_signal()))
         return out

@@ -117,6 +117,54 @@ def _masked_sum(payloads, mask: jnp.ndarray):
     return jax.tree.map(lambda p: jnp.sum(p, axis=0), kept)
 
 
+def radial_distances(unit, center=None) -> jnp.ndarray:
+    """[k] l2 distance of each stacked unit update to ``center`` (a
+    params-shaped tree; ``None`` = the origin, i.e. plain update
+    norms), accumulated leaf-wise over the float leaves in f32. THE
+    shared distance half of the radial clip: ``norm_bound`` measures
+    distance-to-momentum with it, the DP stage (robustness/privacy.py)
+    measures plain update norms — one implementation, one numerics."""
+    sq = jnp.zeros(())
+    if center is None:
+        for u in jax.tree.leaves(unit):
+            if not _is_float(u):
+                continue
+            uf = u.astype(jnp.float32)
+            sq = sq + jnp.sum(jnp.square(uf),
+                              axis=tuple(range(1, uf.ndim)))
+    else:
+        for u, m in zip(jax.tree.leaves(unit), jax.tree.leaves(center)):
+            if not _is_float(u):
+                continue
+            diff = u.astype(jnp.float32) - m[None].astype(jnp.float32)
+            sq = sq + jnp.sum(jnp.square(diff),
+                              axis=tuple(range(1, diff.ndim)))
+    return jnp.sqrt(sq)
+
+
+def radial_clip(payloads, weights: jnp.ndarray, scale: jnp.ndarray,
+                center=None):
+    """Radially shrink each client's unit update toward ``center`` by
+    the per-client factor ``scale`` [k] (1.0 = untouched), operating
+    directly on the WEIGHTED payloads: clipped payload
+    ``w*(m + (u - m)*s) == p*s + (w*(1-s))*m``. ``center=None`` clips
+    toward the origin (``p*s`` — the DP per-client L2 clip); the
+    shared clip half of ``norm_bound``'s centered clipping."""
+    if center is None:
+        return jax.tree.map(
+            lambda p: p * _bcast(scale, p).astype(p.dtype)
+            if _is_float(p) else p, payloads)
+
+    def clip(p, m):
+        if not _is_float(p):
+            return p
+        s = _bcast(scale, p).astype(p.dtype)
+        wm = _bcast(weights * (1.0 - scale), p).astype(p.dtype)
+        return p * s + wm * m[None].astype(p.dtype)
+
+    return jax.tree.map(clip, payloads, center)
+
+
 def pairwise_sq_dists(unit, cand: jnp.ndarray) -> jnp.ndarray:
     """[k, k] pairwise squared l2 distances between the float leaves of
     the stacked unit updates; rows/cols of non-candidates and the
@@ -393,27 +441,12 @@ def robust_aggregate(rule: str, payloads, weights: jnp.ndarray,
         raise ValueError(
             "robust_agg='norm_bound' needs the server momentum tree "
             "(server.aux['norm_bound_m'] — wired by the trainer)")
-    sq = zero
-    for u, m in zip(jax.tree.leaves(unit), jax.tree.leaves(momentum)):
-        if _is_float(u):
-            diff = u.astype(jnp.float32) - m[None].astype(jnp.float32)
-            sq = sq + jnp.sum(jnp.square(diff),
-                              axis=tuple(range(1, diff.ndim)))
-    dist = jnp.sqrt(sq)  # [k] distance to momentum
+    dist = radial_distances(unit, momentum)  # [k] distance to momentum
     med_d = jnp.nanmedian(jnp.where(candb, dist, jnp.nan))
     tau = fault.robust_norm_tau * med_d
     tau = jnp.where(jnp.isnan(tau), 0.0, tau)
     scale = jnp.minimum(1.0, tau / jnp.maximum(dist, 1e-30))
-
-    def clip(p, m):
-        if not _is_float(p):
-            return p
-        # clipped payload w*(m + (u - m)*s) == p*s + (w*(1-s))*m
-        s = _bcast(scale, p).astype(p.dtype)
-        wm = _bcast(weights * (1.0 - scale), p).astype(p.dtype)
-        return p * s + wm * m[None].astype(p.dtype)
-
-    clipped = jax.tree.map(clip, payloads, momentum)
+    clipped = radial_clip(payloads, weights, scale, center=momentum)
     payload_sum = _masked_sum(clipped, cand)
     payload_sum = renormalize_accepted(payload_sum, weights, cand)
     # momentum = this commit's unit-scale aggregate (the center the

@@ -1,0 +1,424 @@
+"""Privacy plane: DP-FedAvg clipped-noise aggregation + a streaming
+RDP/moments accountant (docs/robustness.md "Privacy plane").
+
+Two halves, one contract:
+
+* **In-jit DP stage** at the ``_round_core`` aggregation seam
+  (parallel/federated.py — shared by the sync round and the async
+  buffered commit, like the robust rules): every reporting client's
+  per-unit-weight update is radially L2-clipped to ``dp_clip_norm``
+  through the SAME clip machinery ``norm_bound`` uses
+  (``aggregators.radial_distances`` / ``radial_clip``), then calibrated
+  Gaussian noise ``sigma = dp_noise_multiplier * dp_clip_norm / k`` is
+  added to the weighted estimate (McMahan et al. 2018,
+  arXiv:1710.06963). Noise is drawn from
+  ``fold_in(rng_round, DP_SALT)`` so trajectories stay bit-exact under
+  seeded replay; the whole stage is STATIC config — off (the default)
+  traces the exact pre-DP program (zero extra pytree leaves, HLO
+  byte-identical, like the cohort-stats knob). Composition order is
+  pinned in docs/robustness.md: chaos/guard accept mask -> DP clip ->
+  robust rule (x staleness weights) -> DP noise — the clip bounds each
+  client's contribution BEFORE any rule sees it, the noise lands on
+  the final released estimate.
+
+* **Host-side accountant** (:class:`PrivacyAccountant`): a pure-stdlib
+  f64 RDP/moments accountant (Mironov 2017, arXiv:1702.07476;
+  subsampled Gaussian per Mironov et al. 2019, arXiv:1908.10530)
+  charging one subsampled-Gaussian release per committed round/commit
+  at the run's ACTUAL participation probability — ``sparse`` mode's
+  k/C directly, ``perm`` mode's uniform prefix equivalently, the
+  commit buffer's m/C on the async plane. State persists to
+  ``privacy_accountant.json`` (atomic tmp+replace) and resume-ADOPTS
+  like program_costs.json, so an elastic restart never double-charges
+  (per-round-index dedup) or forgets spend (the file is written before
+  every checkpoint that could become a resume point).
+
+This module's top level imports NOTHING outside the stdlib — the
+accountant is importable by the stdlib-only telemetry/tools layer
+(report, tests) without jax; the in-jit stage functions import jax
+lazily at trace time.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# PRNG fold for the server-side noise draw — its own salt on the round
+# key, disjoint from chaos (0x7FFFFFFD), augmentation (0x7FFFFFFF),
+# the async train fold (0x7FFFFFF9) and the post-round fold (99), so
+# arming DP never perturbs any other deterministic stream.
+DP_SALT = 0x7FFFFFF5
+
+ACCOUNTANT_SCHEMA = "fedtorch_tpu.privacy_accountant/v1"
+ACCOUNTANT_FILE = "privacy_accountant.json"
+
+# Renyi orders the accountant tracks: dense fractional coverage where
+# the conversion optimum usually lands (alpha* = 1 + sqrt(2 z^2
+# log(1/delta) / T) for the pure Gaussian), integers through 63, then
+# a sparse large-alpha tail. Dense-enough that the grid minimum is
+# within 1% of the continuous closed form (pinned in
+# tests/test_privacy.py).
+DEFAULT_ORDERS: Tuple[float, ...] = (
+    tuple(1.0 + i / 8.0 for i in range(1, 81))
+    + tuple(float(a) for a in range(12, 64))
+    + (72.0, 96.0, 128.0, 192.0, 256.0, 512.0))
+
+
+# -- RDP math (pure stdlib f64) ------------------------------------------
+
+def gaussian_rdp(noise_multiplier: float, order: float) -> float:
+    """RDP(alpha) of one Gaussian release at sensitivity 1 and noise
+    stddev ``z = noise_multiplier``: ``alpha / (2 z^2)`` (Mironov
+    2017, Prop. 7) — exact at every real alpha > 1."""
+    return float(order) / (2.0 * float(noise_multiplier) ** 2)
+
+
+def _log_comb(n: int, k: int) -> float:
+    return (math.lgamma(n + 1) - math.lgamma(k + 1)
+            - math.lgamma(n - k + 1))
+
+
+def subsampled_gaussian_rdp(q: float, noise_multiplier: float,
+                            order: float) -> float:
+    """RDP(alpha) of one Poisson-subsampled Gaussian release at
+    sampling probability ``q`` (Mironov et al. 2019, Thm 11 binomial
+    form), evaluated via logsumexp in f64:
+
+        RDP(alpha) = log( sum_{j=0}^{alpha} C(alpha, j) (1-q)^{alpha-j}
+                          q^j exp(j (j-1) / (2 z^2)) ) / (alpha - 1)
+
+    The closed form holds at INTEGER alpha >= 2; a fractional order is
+    charged at ``ceil(alpha)`` — RDP is nondecreasing in alpha, so the
+    integer evaluation upper-bounds the fractional charge and the
+    accountant stays a valid (slightly conservative) upper bound.
+    ``q >= 1`` falls back to the exact un-subsampled Gaussian RDP."""
+    if q <= 0.0:
+        return 0.0
+    if q >= 1.0:
+        return gaussian_rdp(noise_multiplier, order)
+    alpha = max(int(math.ceil(order)), 2)
+    z2 = float(noise_multiplier) ** 2
+    log_q, log_1mq = math.log(q), math.log1p(-q)
+    log_terms = [
+        _log_comb(alpha, j) + j * log_q + (alpha - j) * log_1mq
+        + (j * (j - 1)) / (2.0 * z2)
+        for j in range(alpha + 1)]
+    m = max(log_terms)
+    lse = m + math.log(sum(math.exp(t - m) for t in log_terms))
+    return lse / (alpha - 1.0)
+
+
+def rdp_to_epsilon(orders: Sequence[float], rdp: Sequence[float],
+                   delta: float) -> float:
+    """Classic RDP -> (eps, delta) conversion, minimized over the
+    tracked orders: ``eps = min_a [RDP(a) + log(1/delta)/(a - 1)]``
+    (Mironov 2017, Prop. 3)."""
+    if not 0.0 < delta < 1.0:
+        raise ValueError(f"delta must be in (0, 1), got {delta}")
+    log_inv_delta = math.log(1.0 / delta)
+    best = math.inf
+    for a, r in zip(orders, rdp):
+        if a <= 1.0:
+            continue
+        best = min(best, r + log_inv_delta / (a - 1.0))
+    return best
+
+
+def closed_form_epsilon(noise_multiplier: float, rounds: int,
+                        delta: float) -> float:
+    """CONTINUOUS-alpha optimum of the classic conversion for T
+    compositions of the pure (no-subsampling) Gaussian mechanism:
+
+        eps* = T / (2 z^2) + sqrt(2 T log(1/delta)) / z
+
+    (minimize ``T a/(2 z^2) + log(1/delta)/(a-1)`` over real a > 1).
+    The no-subsampling control the accountant's order grid is
+    validated against — the grid minimum must land within 1%."""
+    z, T = float(noise_multiplier), float(rounds)
+    return (T / (2.0 * z * z)
+            + math.sqrt(2.0 * T * math.log(1.0 / delta)) / z)
+
+
+def calibrate_noise_multiplier(target_epsilon: float, rounds: int,
+                               q: float, delta: float,
+                               orders: Sequence[float] = DEFAULT_ORDERS
+                               ) -> float:
+    """Smallest noise multiplier z whose accounted epsilon after
+    ``rounds`` subsampled releases at probability ``q`` stays <=
+    ``target_epsilon`` — bisection over the accountant itself, so the
+    calibration and the runtime charge can never disagree (the
+    privacy-matrix frontier uses this to hit its eps targets)."""
+    if target_epsilon <= 0.0:
+        raise ValueError(
+            f"target_epsilon must be > 0, got {target_epsilon}")
+
+    def eps_at(z: float) -> float:
+        acc = PrivacyAccountant(z, delta, orders=orders)
+        acc.charge(q, rounds=rounds)
+        return acc.epsilon()
+
+    lo, hi = 1e-2, 1.0
+    while eps_at(hi) > target_epsilon:
+        hi *= 2.0
+        if hi > 1e4:
+            raise ValueError(
+                f"cannot reach eps={target_epsilon} within z<=1e4")
+    for _ in range(60):
+        mid = 0.5 * (lo + hi)
+        if eps_at(mid) > target_epsilon:
+            lo = mid
+        else:
+            hi = mid
+    return hi
+
+
+# -- the streaming accountant --------------------------------------------
+
+class PrivacyAccountant:
+    """Streaming RDP accountant for the run's DP-FedAvg releases.
+
+    One instance per run; :meth:`charge_round` is fed every COMMITTED
+    round/commit index with the round's participation probability and
+    dedups by index — a supervisor retry or an elastic restart
+    re-running round r charges it exactly once. Persistence follows
+    the program_costs.json conventions: schema-versioned JSON, atomic
+    tmp-then-replace writes, :meth:`load_existing` adoption on resume
+    (refusing, by name, an accountant file whose mechanism parameters
+    disagree with the run's config — silently merging two different
+    mechanisms would corrupt the spend)."""
+
+    def __init__(self, noise_multiplier: float, delta: float,
+                 orders: Sequence[float] = DEFAULT_ORDERS):
+        if noise_multiplier <= 0.0:
+            raise ValueError(
+                f"noise_multiplier must be > 0, got {noise_multiplier}")
+        if not 0.0 < delta < 1.0:
+            raise ValueError(f"delta must be in (0, 1), got {delta}")
+        self.noise_multiplier = float(noise_multiplier)
+        self.delta = float(delta)
+        self.orders: Tuple[float, ...] = tuple(
+            float(a) for a in orders)
+        self._rdp: List[float] = [0.0] * len(self.orders)
+        self.charged_rounds = 0
+        self.last_charged_round = -1
+        # per-q charge counts, for the persisted audit trail
+        self.charges: Dict[str, int] = {}
+        self._step_cache: Dict[float, List[float]] = {}
+
+    # -- charging ------------------------------------------------------
+    def _step(self, q: float) -> List[float]:
+        q = float(q)
+        if not 0.0 < q <= 1.0:
+            raise ValueError(
+                f"participation probability must be in (0, 1], got {q}")
+        step = self._step_cache.get(q)
+        if step is None:
+            step = [subsampled_gaussian_rdp(q, self.noise_multiplier, a)
+                    for a in self.orders]
+            self._step_cache[q] = step
+        return step
+
+    def charge(self, q: float, rounds: int = 1) -> None:
+        """Accumulate ``rounds`` subsampled-Gaussian releases at
+        participation probability ``q``."""
+        if rounds <= 0:
+            raise ValueError(f"rounds must be > 0, got {rounds}")
+        step = self._step(q)
+        self._rdp = [r + rounds * s for r, s in zip(self._rdp, step)]
+        self.charged_rounds += int(rounds)
+        key = repr(float(q))
+        self.charges[key] = self.charges.get(key, 0) + int(rounds)
+
+    def charge_round(self, round_idx: int, q: float) -> bool:
+        """Charge round ``round_idx`` exactly once; a duplicate or
+        older index (supervisor retry of the same round, elastic
+        restart re-running adopted rounds) is refused, returning
+        False — the never-double-charge half of the resume contract."""
+        if round_idx <= self.last_charged_round:
+            return False
+        self.charge(q, rounds=1)
+        self.last_charged_round = int(round_idx)
+        return True
+
+    # -- reading -------------------------------------------------------
+    def epsilon(self) -> float:
+        """Cumulative (eps, delta)-DP epsilon at the run's delta."""
+        if self.charged_rounds == 0:
+            return 0.0
+        return rdp_to_epsilon(self.orders, self._rdp, self.delta)
+
+    def preview_epsilon(self, q: float, extra_rounds: int = 1) -> float:
+        """Epsilon AFTER ``extra_rounds`` more releases at ``q``,
+        without mutating state — the budget lifecycle's affordability
+        pre-check (stop at the last affordable round, not one past)."""
+        step = self._step(q)
+        rdp = [r + extra_rounds * s for r, s in zip(self._rdp, step)]
+        return rdp_to_epsilon(self.orders, rdp, self.delta)
+
+    # -- persistence (program_costs.json conventions) ------------------
+    def state(self) -> Dict:
+        return {
+            "schema": ACCOUNTANT_SCHEMA,
+            "noise_multiplier": self.noise_multiplier,
+            "delta": self.delta,
+            "orders": list(self.orders),
+            "rdp": list(self._rdp),
+            "charged_rounds": self.charged_rounds,
+            "last_charged_round": self.last_charged_round,
+            "charges": dict(self.charges),
+            "epsilon_spent": self.epsilon(),
+        }
+
+    def adopt_state(self, doc: Dict) -> None:
+        """Adopt a persisted accountant document; refuses, by name, a
+        document whose mechanism parameters disagree with this run's
+        config (resuming with a different z/delta/order grid would
+        silently corrupt the spend — change the config back or start
+        a fresh run dir)."""
+        if doc.get("schema") != ACCOUNTANT_SCHEMA:
+            raise ValueError(
+                f"privacy accountant schema {doc.get('schema')!r} != "
+                f"{ACCOUNTANT_SCHEMA!r}")
+        for name, mine in (
+                ("noise_multiplier", self.noise_multiplier),
+                ("delta", self.delta)):
+            theirs = doc.get(name)
+            if theirs != mine:
+                raise ValueError(
+                    f"privacy accountant resume mismatch: persisted "
+                    f"{name}={theirs!r} != configured {mine!r} — the "
+                    "spend of a different mechanism cannot be adopted")
+        orders = tuple(float(a) for a in doc.get("orders", ()))
+        if orders != self.orders:
+            raise ValueError(
+                "privacy accountant resume mismatch: persisted order "
+                "grid differs from this build's DEFAULT_ORDERS")
+        rdp = [float(r) for r in doc.get("rdp", ())]
+        if len(rdp) != len(self.orders):
+            raise ValueError(
+                "privacy accountant document is torn: rdp vector "
+                f"length {len(rdp)} != {len(self.orders)} orders")
+        self._rdp = rdp
+        self.charged_rounds = int(doc.get("charged_rounds", 0))
+        self.last_charged_round = int(doc.get("last_charged_round", -1))
+        self.charges = {str(k): int(v)
+                        for k, v in dict(doc.get("charges", {})).items()}
+
+    def save(self, run_dir: str) -> bool:
+        """Atomic write of the accountant state into the run dir.
+        Called BEFORE every checkpoint write (so spend through any
+        resume point is durable — never-forget-spend) and from the
+        loop's finally block; absorbs I/O failure (telemetry-style:
+        persistence must not outcrash the run it accounts)."""
+        try:
+            os.makedirs(run_dir, exist_ok=True)
+            path = os.path.join(run_dir, ACCOUNTANT_FILE)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(self.state(), f, indent=2, sort_keys=True)
+            os.replace(tmp, path)
+            return True
+        except OSError:
+            return False
+
+    def load_existing(self, run_dir: str) -> bool:
+        """Adopt the run dir's ``privacy_accountant.json`` on elastic
+        restart (the program_costs.json convention) — spend resumes
+        instead of resetting to zero. Returns False when there is
+        nothing to adopt; RAISES on a parameter mismatch (see
+        :meth:`adopt_state`) rather than under-counting."""
+        path = os.path.join(run_dir, ACCOUNTANT_FILE)
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except FileNotFoundError:
+            return False
+        except (OSError, json.JSONDecodeError):
+            # a torn document (host fault mid-replace cannot happen —
+            # os.replace is atomic — but a foreign/corrupt file can):
+            # refuse silently-forgetting spend
+            raise ValueError(
+                f"privacy accountant file {path!r} is unreadable; "
+                "remove it (accepting the spend reset) or restore it "
+                "before resuming a DP run")
+        self.adopt_state(doc)
+        return True
+
+
+# -- the in-jit DP stage (lazy jax imports: trace-time only) -------------
+
+def dp_noise_stddev(noise_multiplier: float, clip_norm: float,
+                    cohort_k: int) -> float:
+    """STATIC per-round noise stddev on the weighted-MEAN estimate:
+    ``sigma = z * S / k`` (DP-FedAvg server noise, McMahan et al.
+    2018). ``cohort_k`` is the real cohort width — k_online on the
+    sync planes (over-selection dispatches more but the round closes
+    on k_online), the commit buffer size m on the async plane."""
+    return (float(noise_multiplier) * float(clip_norm)
+            / float(cohort_k))
+
+
+def dp_clip_payloads(payloads, weights, accept, clip_norm: float):
+    """In-jit per-client L2 clip of the stacked ``[k]`` payloads to
+    ``clip_norm``, through the SAME radial-clip machinery as
+    ``norm_bound`` (aggregators.radial_distances / radial_clip with
+    ``center=None`` — clip toward the origin at a FIXED radius instead
+    of toward the momentum at a median-relative one). Returns
+    ``(clipped_payloads, clipped_frac)`` where ``clipped_frac`` is the
+    fraction of accepted candidates the clip actually shrank."""
+    import jax.numpy as jnp
+
+    from fedtorch_tpu.robustness.aggregators import (
+        _unit_updates, radial_clip, radial_distances,
+    )
+    unit = _unit_updates(payloads, weights)
+    dist = radial_distances(unit)  # [k] unit-update l2 norms
+    scale = jnp.minimum(1.0, clip_norm / jnp.maximum(dist, 1e-30))
+    clipped = radial_clip(payloads, weights, scale)
+    acc = accept if accept is not None else jnp.ones(weights.shape)
+    cand = acc * (weights > 0.0).astype(acc.dtype)
+    frac = jnp.sum(cand * (scale < 1.0).astype(cand.dtype)) \
+        / jnp.maximum(jnp.sum(cand), 1.0)
+    return clipped, frac
+
+
+def dp_add_noise(payload_sum, rng_round, weights, sigma: float,
+                 noise_scale):
+    """Add calibrated Gaussian noise to the aggregated payload sum:
+    ``payload_sum`` carries the full round weight ``W = sum(weights)``,
+    so noise at stddev ``W * sigma`` on the sum is exactly ``sigma``
+    on the weighted-mean estimate the server releases. The key is
+    ``fold_in(rng_round, DP_SALT)`` with a per-leaf sub-fold — bit-
+    exact under seeded replay, disjoint from every other stream.
+    ``noise_scale`` is the traced f32 scalar riding ``server.aux``
+    (1.0 armed, 0.0 after a budget 'degrade') — exhaustion flips
+    DATA, never the program, so there is no retrace."""
+    import jax
+    import jax.numpy as jnp
+
+    from fedtorch_tpu.robustness.aggregators import _is_float
+    key = jax.random.fold_in(rng_round, DP_SALT)
+    amp = (jnp.sum(weights) * sigma
+           * noise_scale).astype(jnp.float32)
+    counter = [0]
+
+    def noisy(p):
+        if not _is_float(p):
+            return p
+        leaf_key = jax.random.fold_in(key, counter[0])
+        counter[0] += 1
+        xi = jax.random.normal(leaf_key, p.shape, jnp.float32)
+        return (p.astype(jnp.float32) + amp * xi).astype(p.dtype)
+
+    return jax.tree.map(noisy, payload_sum)
+
+
+__all__ = [
+    "ACCOUNTANT_FILE", "ACCOUNTANT_SCHEMA", "DEFAULT_ORDERS", "DP_SALT",
+    "PrivacyAccountant", "calibrate_noise_multiplier",
+    "closed_form_epsilon", "dp_add_noise", "dp_clip_payloads",
+    "dp_noise_stddev", "gaussian_rdp", "rdp_to_epsilon",
+    "subsampled_gaussian_rdp",
+]

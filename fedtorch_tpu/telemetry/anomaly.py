@@ -31,7 +31,8 @@ from typing import Dict, List, Optional, Tuple
 # max(n_online, 1)) — the raw counts scale with k and would alias
 # cohort-size changes into anomalies.
 ANOMALY_FIELDS = ("loss", "cohort_dispersion", "reject_rate",
-                  "staleness", "dropout_rate", "deadline_miss_rate")
+                  "staleness", "dropout_rate", "deadline_miss_rate",
+                  "dp_clipped_frac")
 
 
 class EwmaAnomalyDetector:
@@ -67,6 +68,11 @@ class EwmaAnomalyDetector:
         if "deadline_missed" in row and "n_online" in row:
             out["deadline_miss_rate"] = float(row["deadline_missed"]) \
                 / max(float(row["n_online"]), 1.0)
+        # privacy plane: dp_clipped_frac is already a cohort-size-
+        # invariant fraction — a clip-saturation excursion means the
+        # update distribution shifted against the fixed dp_clip_norm
+        if "dp_clipped_frac" in row:
+            out["dp_clipped_frac"] = float(row["dp_clipped_frac"])
         return out
 
     def observe(self, row: Dict) -> List[Dict]:

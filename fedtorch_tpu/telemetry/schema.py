@@ -151,6 +151,15 @@ METRICS_OPTIONAL = {
     "cohort_norm_med": "median accepted unit-update norm",
     "cohort_norm_q75": "75th-percentile accepted unit-update norm",
     "cohort_norm_max": "max accepted unit-update norm",
+    # privacy plane (robustness/privacy.py; docs/robustness.md
+    # "Privacy plane") — present only when fault.dp_noise_multiplier
+    # arms the DP aggregation stage
+    "dp_clipped_frac": "fraction of accepted clients the DP L2 clip "
+                       "actually shrank this round",
+    "dp_noise_sigma": "applied DP noise stddev on the released "
+                      "estimate (0 after a budget 'degrade')",
+    "dp_epsilon_spent": "cumulative accounted epsilon at dp_delta "
+                        "(host-side RDP accountant)",
     # per-client ledger (telemetry/ledger.py)
     "ledger_tracked": "clients with exact per-client ledger records "
                       "(dense: the population; sketch: the "

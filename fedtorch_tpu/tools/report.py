@@ -22,6 +22,7 @@ Usage::
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 from typing import Dict, List, Optional
@@ -228,6 +229,44 @@ def summarize(run_dir: str, run: Optional[Dict] = None) -> Dict:
         fed["ledger_error"] = str(e)
     if fed:
         s["federation"] = fed
+    # the Privacy section (docs/robustness.md "Privacy plane"): spent
+    # (eps, delta) from the durable accountant file (authoritative) or
+    # the last row's streamed gauge, clip saturation over the run, and
+    # the budget-exhaustion outcome — the answer to "what privacy
+    # claim does this run support".
+    priv: Dict = {}
+    try:
+        # privacy.ACCOUNTANT_FILE, spelled inline: the ops tools never
+        # import the robustness package (its __init__ pulls jax)
+        with open(os.path.join(run_dir, "privacy_accountant.json")) as f:
+            acc_doc = json.load(f)
+        priv["epsilon_spent"] = acc_doc.get("epsilon_spent")
+        priv["delta"] = acc_doc.get("delta")
+        priv["noise_multiplier"] = acc_doc.get("noise_multiplier")
+        priv["charged_rounds"] = acc_doc.get("charged_rounds")
+    except (OSError, json.JSONDecodeError):
+        pass
+    eps_rows = [r["dp_epsilon_spent"] for r in rows
+                if "dp_epsilon_spent" in r]
+    if eps_rows and "epsilon_spent" not in priv:
+        priv["epsilon_spent"] = eps_rows[-1]
+    clip = [r["dp_clipped_frac"] for r in rows
+            if "dp_clipped_frac" in r]
+    if clip:
+        priv["clipped_frac_last"] = clip[-1]
+        priv["clipped_frac_mean"] = sum(clip) / len(clip)
+    sig = [r["dp_noise_sigma"] for r in rows if "dp_noise_sigma" in r]
+    if sig:
+        priv["noise_sigma_last"] = sig[-1]
+    for ev in reversed(run["events"]):
+        if ev.get("event") == "privacy.budget_exhausted":
+            priv["exhausted"] = {
+                k: ev[k] for k in ("round", "action", "epsilon_spent",
+                                   "epsilon_budget")
+                if k in ev}
+            break
+    if priv:
+        s["privacy"] = priv
     # round-wall critical path (telemetry/critical_path.py;
     # docs/observability.md "Operating and comparing runs"): the
     # stream plane's overlap efficiency and the host/device wall
@@ -259,7 +298,7 @@ def summarize(run_dir: str, run: Optional[Dict] = None) -> Dict:
     last = rows[-1]
     for key in sorted(last):
         if key.startswith(("stream_", "async_", "ckpt_", "sup_",
-                           "cohort_", "ledger_")) \
+                           "cohort_", "ledger_", "dp_")) \
                 or key in ("overlap_efficiency", "round_device_min_s",
                            "round_host_frac",
                            "model_flops_utilization",
@@ -381,6 +420,29 @@ def render(run_dir: str) -> str:
                           sorted(fed["staleness_hist"].items(),
                                  key=lambda p: int(p[0])))
             lines.append(f"  staleness histogram: {kv}")
+    priv = s.get("privacy") or {}
+    if priv:
+        lines.append("privacy plane (DP-FedAvg + RDP accountant):")
+        if priv.get("epsilon_spent") is not None:
+            line = f"  spent epsilon {priv['epsilon_spent']:.4f}"
+            if priv.get("delta") is not None:
+                line += f" at delta {priv['delta']:g}"
+            if priv.get("charged_rounds") is not None:
+                line += f"  ({priv['charged_rounds']} charged rounds)"
+            lines.append(line)
+        if "clipped_frac_last" in priv:
+            lines.append(
+                f"  clipped frac: last {priv['clipped_frac_last']:.3f}"
+                f"  mean {priv['clipped_frac_mean']:.3f}")
+        if "noise_sigma_last" in priv:
+            lines.append(
+                f"  noise sigma (last): {priv['noise_sigma_last']:.4g}")
+        if "exhausted" in priv:
+            ex = priv["exhausted"]
+            lines.append(
+                f"  budget exhausted at round {ex.get('round')} "
+                f"(action={ex.get('action')}, budget="
+                f"{ex.get('epsilon_budget')})")
     if s["last_gauges"]:
         lines.append("subsystem gauges (last round):")
         for k, v in s["last_gauges"].items():

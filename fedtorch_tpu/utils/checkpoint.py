@@ -87,6 +87,9 @@ def _compat_meta(cfg: ExperimentConfig) -> dict:
         # as a bool (not the rule name) so e.g. mean <-> median resume,
         # which shares the aux structure, stays legal.
         "robust_momentum": cfg.fault.robust_agg == "norm_bound",
+        # the DP stage wraps server.aux with its traced noise scale
+        # (robustness/privacy.py) — the same structural-mismatch class
+        "dp_aggregation": cfg.fault.dp_armed,
     }
 
 
@@ -632,9 +635,11 @@ def maybe_resume(directory: Optional[str], server, clients,
     # keys absent from older checkpoints default to the value every
     # pre-feature run had: all-sync (the only mode that existed) and no
     # norm_bound momentum wrap
-    legacy_defaults = {"sync_mode": "sync", "robust_momentum": False}
+    legacy_defaults = {"sync_mode": "sync", "robust_momentum": False,
+                       "dp_aggregation": False}
     for key in ("dataset", "batch_size", "arch", "algorithm",
-                "num_clients", "sync_mode", "robust_momentum"):
+                "num_clients", "sync_mode", "robust_momentum",
+                "dp_aggregation"):
         was = old.get(key, legacy_defaults[key]) \
             if key in legacy_defaults else old[key]
         if was != new[key]:

@@ -451,6 +451,294 @@ def run_availability_matrix(rounds: int = 12, smoke: bool = False,
     return report
 
 
+def run_privacy_matrix(rounds: int = 12, smoke: bool = False,
+                       seed: int = 0, out_path: str = None) -> dict:
+    """The privacy-plane drill (docs/robustness.md §8) → DP_AB.json.
+    Five legs:
+
+    * ``off_identical`` — the DP-off build is the pre-PR program:
+      lowered round HLO byte-identical across disarmed DP knob
+      settings, server.aux unwrapped, no dp_* metrics fields, and the
+      off trajectory bitwise-replayable.
+    * ``closed_form_control`` — the stdlib RDP accountant within 1%
+      of the continuous closed-form ε on the pure-Gaussian
+      no-subsampling control, and subsampling strictly amplifies.
+    * ``frontier`` — the measured ε-vs-accuracy frontier at
+      ε ∈ {2, 8, ∞} (δ fixed): noise calibrated by bisection against
+      the accountant itself, every armed cell bitwise-replayable and
+      traced exactly once, spend within budget.
+    * ``layered`` — DP × trimmed_mean × byzantine cohort: the layered
+      defense completes every round with finite params while both the
+      robust rule and the clip+noise stage fire.
+    * ``exhaustion`` — both budget actions drilled through the real
+      CLI loop: ``stop`` ends at the last affordable round with a
+      `complete` intent + `privacy.budget_exhausted` event; `degrade`
+      finishes every round noise-free with a `degraded` intent.
+      Neither wedges.
+    """
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import hashlib
+    import shutil
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from fedtorch_tpu.algorithms import make_algorithm
+    from fedtorch_tpu.config import (
+        CheckpointConfig, DataConfig, ExperimentConfig, FaultConfig,
+        FederatedConfig, ModelConfig, OptimConfig, TrainConfig,
+    )
+    from fedtorch_tpu.data import build_federated_data
+    from fedtorch_tpu.models import define_model
+    from fedtorch_tpu.parallel import FederatedTrainer
+    from fedtorch_tpu.robustness.privacy import (
+        PrivacyAccountant, calibrate_noise_multiplier,
+        closed_form_epsilon,
+    )
+    from fedtorch_tpu.utils.tracing import RecompilationSentinel
+
+    C = 8 if smoke else 16
+    B = 16 if smoke else 32
+    rounds = max(rounds, 6)
+    delta = 1e-5
+    t0 = time.time()
+    report = {"rounds": rounds, "clients": C, "seed": seed,
+              "delta": delta, "legs": {}}
+
+    def fingerprint(tree) -> str:
+        h = hashlib.sha256()
+        for leaf in jax.tree.leaves(tree):
+            h.update(np.asarray(leaf).tobytes())
+        return h.hexdigest()[:16]
+
+    def make_cfg(fault: FaultConfig, num_comms: int = None,
+                 run_dir: str = None):
+        return ExperimentConfig(
+            data=DataConfig(dataset="synthetic", synthetic_dim=30,
+                            batch_size=B, synthetic_alpha=0.5,
+                            synthetic_beta=0.5),
+            federated=FederatedConfig(
+                federated=True, num_clients=C,
+                num_comms=num_comms or rounds,
+                online_client_rate=0.5, algorithm="fedavg",
+                sync_type="local_step", sync_mode="sync"),
+            model=ModelConfig(arch="logistic_regression"),
+            optim=OptimConfig(lr=0.5, weight_decay=0.0),
+            train=TrainConfig(local_step=3),
+            checkpoint=CheckpointConfig(run_dir=run_dir, debug=False)
+            if run_dir else CheckpointConfig(),
+            fault=fault,
+        ).finalize()
+
+    def make_trainer(fault: FaultConfig):
+        cfg = make_cfg(fault)
+        data = build_federated_data(cfg)
+        model = define_model(cfg, batch_size=B)
+        return FederatedTrainer(cfg, model, make_algorithm(cfg),
+                                data.train)
+
+    def dp_run(fault: FaultConfig):
+        """rounds sync rounds; per-round fingerprints + tail accuracy
+        + dp gauges, trace count."""
+        t = make_trainer(fault)
+        server, clients = t.init_state(jax.random.key(seed))
+        fps, accs, gauges = [], [], {}
+        totals = {"byzantine": 0.0, "robust_trimmed": 0.0}
+        with RecompilationSentinel() as sentinel:
+            for _ in range(rounds):
+                server, clients, m = t.run_round(server, clients)
+                sc = t.round_host_scalars(clients, m)
+                accs.append(sc["acc_sum"] / max(sc["n_online"], 1.0))
+                fps.append(fingerprint(server.params))
+                for key_ in totals:
+                    totals[key_] += sc[key_]
+                gauges = {k: sc[k] for k in
+                          ("dp_clipped_frac", "dp_noise_sigma")
+                          if k in sc}
+        return (fps, sum(accs[-3:]) / 3, gauges,
+                sum(sentinel.counts.values()), server, m, totals)
+
+    # -- leg 1: DP off IS the pre-PR program ----------------------------
+    def lowered(fault: FaultConfig) -> str:
+        t = make_trainer(fault)
+        server, clients = t.init_state(jax.random.key(seed))
+        return t._round_jit.lower(server, clients, t.data,
+                                  t.val_data).as_text()
+
+    hlo_plain = lowered(FaultConfig())
+    # disarmed DP knobs at non-default values must not reach the
+    # lowered program (static-config contract)
+    hlo_disarmed = lowered(FaultConfig(dp_noise_multiplier=0.0,
+                                       dp_clip_norm=9.0, dp_delta=0.5,
+                                       dp_budget_action="degrade"))
+    t_off = make_trainer(FaultConfig())
+    s_off, _ = t_off.init_state(jax.random.key(seed))
+    fps_off, acc_off, g_off, tr_off, _, m_off, _ = dp_run(FaultConfig())
+    fps_off2 = dp_run(FaultConfig())[0]
+    report["legs"]["off_identical"] = {
+        "hlo_bytes": len(hlo_plain),
+        "hlo_byte_identical": hlo_plain == hlo_disarmed,
+        "aux_unwrapped": not (isinstance(s_off.aux, dict)
+                              and "dp_noise_scale" in s_off.aux),
+        "no_dp_metrics": m_off.dp_clipped_frac is None
+        and "dp_clipped_frac" not in g_off,
+        "replay_identical": fps_off == fps_off2,
+        "retraces": tr_off - 1,
+    }
+    assert hlo_plain == hlo_disarmed, \
+        "disarmed DP knobs leaked into the lowered round program"
+    assert m_off.dp_clipped_frac is None, \
+        "DP-off round emitted dp metrics fields"
+    assert fps_off == fps_off2, "off leg not bitwise-replayable"
+
+    # -- leg 2: accountant vs closed form -------------------------------
+    z_ctl, T_ctl = 1.1, 100
+    acc_ctl = PrivacyAccountant(z_ctl, delta)
+    acc_ctl.charge(1.0, rounds=T_ctl)
+    eps_grid = acc_ctl.epsilon()
+    eps_cf = closed_form_epsilon(z_ctl, T_ctl, delta)
+    rel = abs(eps_grid - eps_cf) / eps_cf
+    sub = PrivacyAccountant(z_ctl, delta)
+    sub.charge(0.25, rounds=T_ctl)
+    report["legs"]["closed_form_control"] = {
+        "noise_multiplier": z_ctl, "rounds": T_ctl,
+        "epsilon_accounted": eps_grid, "epsilon_closed_form": eps_cf,
+        "rel_error": rel,
+        "epsilon_subsampled_q0.25": sub.epsilon(),
+    }
+    assert rel < 0.01, (
+        f"accountant {eps_grid} vs closed form {eps_cf}: rel {rel}")
+    assert sub.epsilon() < eps_grid, "subsampling did not amplify"
+
+    # -- leg 3: the eps-vs-accuracy frontier ----------------------------
+    q = min(1.0, (C // 2) / C)  # online_client_rate=0.5 cohort
+    clip = 0.5
+    frontier = []
+    for eps_target in (2.0, 8.0, float("inf")):
+        if eps_target == float("inf"):
+            fault = FaultConfig()
+            z = 0.0
+        else:
+            z = calibrate_noise_multiplier(eps_target, rounds, q,
+                                           delta)
+            fault = FaultConfig(dp_noise_multiplier=z,
+                                dp_clip_norm=clip, dp_delta=delta)
+        fps1, acc1, gauges, traces = dp_run(fault)[:4]
+        fps2 = dp_run(fault)[0]
+        spent = None
+        if z > 0.0:
+            a = PrivacyAccountant(z, delta)
+            a.charge(q, rounds=rounds)
+            spent = a.epsilon()
+        cell = {"epsilon_target": eps_target if eps_target != float(
+            "inf") else "inf",
+            "noise_multiplier": z, "epsilon_spent": spent,
+            "final_acc": acc1, "gauges": gauges,
+            "replay_identical": fps1 == fps2,
+            "retraces": traces - 1}
+        frontier.append(cell)
+        assert fps1 == fps2, \
+            f"eps={eps_target} cell not bitwise-replayable"
+        assert traces == 1, \
+            f"eps={eps_target} cell traced {traces}x"
+        if spent is not None:
+            assert spent <= eps_target * 1.001, (
+                f"calibrated z={z} overspent: {spent} > {eps_target}")
+    report["legs"]["frontier"] = frontier
+
+    # -- leg 4: DP x trimmed_mean x byzantine cohort --------------------
+    z8 = calibrate_noise_multiplier(8.0, rounds, q, delta)
+    layered = FaultConfig(dp_noise_multiplier=z8, dp_clip_norm=clip,
+                          dp_delta=delta, robust_agg="trimmed_mean",
+                          robust_trim_frac=0.25, byzantine_rate=0.25,
+                          byzantine_mode="sign_flip",
+                          byzantine_scale=3.0)
+    fps1, acc_l, g_l, traces, server_l, _, tot_l = dp_run(layered)
+    fps2 = dp_run(layered)[0]
+    finite = all(np.isfinite(np.asarray(x)).all()
+                 for x in jax.tree.leaves(server_l.params))
+    report["legs"]["layered"] = {
+        "noise_multiplier": z8, "final_acc": acc_l,
+        "robust_trimmed_total": tot_l["robust_trimmed"],
+        "byzantine_total": tot_l["byzantine"],
+        "dp_gauges": g_l, "params_finite": finite,
+        "replay_identical": fps1 == fps2, "retraces": traces - 1,
+    }
+    assert fps1 == fps2 and traces == 1, "layered cell broke contracts"
+    assert finite, "layered defense diverged to non-finite params"
+    assert tot_l["byzantine"] > 0, "adversary never fired"
+    assert tot_l["robust_trimmed"] > 0, "trimmed_mean never trimmed"
+    assert g_l.get("dp_noise_sigma", 0.0) > 0, "DP noise not applied"
+
+    # -- leg 5: budget exhaustion drills (real CLI loop) ----------------
+    from fedtorch_tpu.cli import run_experiment
+    from fedtorch_tpu.telemetry import read_health
+    from fedtorch_tpu.telemetry.schema import iter_jsonl
+
+    z_ex = 1.0
+    half = rounds // 2
+    affordable = PrivacyAccountant(z_ex, delta)
+    affordable.charge(q, rounds=half)
+    budget = affordable.epsilon() * 1.0001  # affords exactly `half`
+    exdrills = {}
+    for action in ("stop", "degrade"):
+        run_root = tempfile.mkdtemp(prefix=f"dp_{action}_")
+        run_dir = os.path.join(run_root, "run")
+        cfg = make_cfg(FaultConfig(dp_noise_multiplier=z_ex,
+                                   dp_clip_norm=clip, dp_delta=delta,
+                                   dp_epsilon_budget=budget,
+                                   dp_budget_action=action),
+                       run_dir=run_dir)
+        res = run_experiment(cfg)
+        events = [e for e in iter_jsonl(
+            os.path.join(run_dir, "events.jsonl"))
+            if e.get("event") == "privacy.budget_exhausted"]
+        rows = [r for r in iter_jsonl(
+            os.path.join(run_dir, "metrics.jsonl")) if "round" in r]
+        intent = read_health(run_dir)["intent"]
+        with open(os.path.join(run_dir,
+                               "privacy_accountant.json")) as f:
+            acc_doc = json.load(f)
+        exdrills[action] = {
+            "rounds_completed": len(rows),
+            "exhausted_at_round": res.get("dp_exhausted_at_round"),
+            "intent": intent, "events": len(events),
+            "epsilon_spent": acc_doc["epsilon_spent"],
+            "epsilon_budget": budget,
+            "sigma_tail": rows[-1]["dp_noise_sigma"] if rows else None,
+        }
+        assert len(events) == 1 and events[0]["action"] == action, \
+            f"{action}: budget event missing/mislabelled"
+        assert acc_doc["epsilon_spent"] <= budget * 1.0001, \
+            f"{action}: overspent the budget"
+        if action == "stop":
+            assert intent == "complete", \
+                f"stop drill exited intent={intent}, want complete"
+            assert len(rows) == half == res["dp_exhausted_at_round"], (
+                f"stop drill ran {len(rows)} rounds, want {half}")
+        else:
+            assert intent == "degraded", \
+                f"degrade drill exited intent={intent}, want degraded"
+            assert len(rows) == rounds, \
+                f"degrade drill wedged at {len(rows)}/{rounds}"
+            assert rows[-1]["dp_noise_sigma"] == 0.0, \
+                "degrade tail still noising"
+            assert rows[half - 1]["dp_noise_sigma"] > 0.0, \
+                "pre-exhaustion rounds were not noised"
+        shutil.rmtree(run_root, ignore_errors=True)
+    report["legs"]["exhaustion"] = exdrills
+
+    report["wall_seconds"] = round(time.time() - t0, 1)
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+        log(f"wrote {out_path}")
+    return report
+
+
 def run_builder_matrix(rounds: int = 8, smoke: bool = False,
                        seed: int = 0, out_path: str = None) -> dict:
     """Round-program-builder smoke (ISSUE 11): three representative
@@ -1387,7 +1675,25 @@ def main():
                          "(docs/observability.md 'Federation plane')")
     ap.add_argument("--ledger-out", default="COHORT_AB.json",
                     help="output path for the ledger-attack report")
+    ap.add_argument("--privacy-matrix", action="store_true",
+                    help="run the privacy-plane drill instead: DP-off "
+                         "HLO byte-identity, the RDP accountant vs "
+                         "closed-form epsilon, the measured "
+                         "eps-vs-accuracy frontier (eps in {2,8,inf}, "
+                         "noise calibrated against the accountant), "
+                         "DP x trimmed_mean x byzantine layered leg, "
+                         "and both budget-exhaustion drills through "
+                         "the real CLI loop; writes --privacy-out "
+                         "(docs/robustness.md §8)")
+    ap.add_argument("--privacy-out", default="DP_AB.json",
+                    help="output path for the privacy report")
     args = ap.parse_args()
+    if args.privacy_matrix:
+        report = run_privacy_matrix(rounds=args.rounds,
+                                    smoke=args.smoke, seed=args.seed,
+                                    out_path=args.privacy_out)
+        log(json.dumps(report, indent=1, sort_keys=True))
+        return
     if args.availability_matrix:
         report = run_availability_matrix(rounds=args.rounds,
                                          smoke=args.smoke,

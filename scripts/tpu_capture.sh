@@ -47,6 +47,14 @@
 #                         escalates into the supervisor, async
 #                         trace-model dropouts deterministic —
 #                         docs/robustness.md "Deployment realism")
+#   privacy          scripts/chaos_suite.py --privacy-matrix
+#                        -> DP_AB.json (DP-FedAvg drill: DP-off leg
+#                         HLO-byte-identical + bitwise replay, RDP
+#                         accountant within 1% of the closed form,
+#                         epsilon-vs-accuracy frontier at 3 budgets
+#                         trace-once, DP x trimmed_mean x byzantine
+#                         layering, both budget-exhaustion actions —
+#                         docs/robustness.md "Privacy plane")
 #   builder-matrix   scripts/chaos_suite.py --builder-matrix
 #                        -> BUILDER_MATRIX.json (round-program-builder
 #                         smoke: scanned device, scanned streamed and
@@ -144,7 +152,7 @@ TRIES="${TPU_CAPTURE_WAIT_TRIES:-90}"   # ~6 h of patience by default
 # audit rides early: it is seconds of abstract lowering and proves the
 # program invariants on the real backend before the long benches run
 DEFAULT_STEPS="audit concurrency mfu stream population builder-matrix avail \
-async attack host-chaos cohort telemetry compare bench-streaming \
+privacy async attack host-chaos cohort telemetry compare bench-streaming \
 bench-dispatch bench-unroll bench zoo pallas flash-train vmap baseline"
 STEPS="${*:-$DEFAULT_STEPS}"
 
@@ -180,6 +188,9 @@ for step in $STEPS; do
         avail)          run python scripts/chaos_suite.py \
                             --availability-matrix --rounds 12 \
                             --avail-out AVAIL_AB.json ;;
+        privacy)        run python scripts/chaos_suite.py \
+                            --privacy-matrix --rounds 12 \
+                            --privacy-out DP_AB.json ;;
         host-chaos)     run python scripts/chaos_suite.py \
                             --host-fault-matrix --rounds 12 \
                             --host-out HOST_CHAOS_AB.json ;;

@@ -149,3 +149,30 @@ def test_availability_matrix_acceptance():
     assert legs["degrade_vs_abort"]["abort_skip_causes"] == ["quorum"]
     assert legs["async_dropout"]["fingerprint_identical"]
     assert legs["async_dropout"]["dropouts"] > 0
+
+@pytest.mark.slow
+def test_privacy_matrix_acceptance():
+    """ISSUE 19 acceptance: disarmed DP knobs keep the lowered round
+    program HLO-byte-identical (zero extra pytree leaves); the RDP
+    accountant matches the closed-form pure-Gaussian epsilon within
+    1%; the epsilon frontier cells replay bitwise, trace once, and
+    spend within target; DP layers under trimmed_mean + byzantine;
+    both budget-exhaustion actions drill cleanly through the CLI."""
+    from chaos_suite import run_privacy_matrix
+    report = run_privacy_matrix(rounds=8, smoke=True)
+    legs = report["legs"]
+    assert legs["off_identical"]["hlo_byte_identical"]
+    assert legs["off_identical"]["no_dp_metrics"]
+    assert legs["off_identical"]["retraces"] == 0
+    assert legs["closed_form_control"]["rel_error"] < 0.01
+    assert (legs["closed_form_control"]["epsilon_subsampled_q0.25"]
+            < legs["closed_form_control"]["epsilon_accounted"])
+    assert len(legs["frontier"]) == 3
+    for cell in legs["frontier"]:
+        assert cell["replay_identical"] and cell["retraces"] == 0
+    assert legs["layered"]["params_finite"]
+    assert legs["layered"]["byzantine_total"] > 0
+    assert legs["layered"]["robust_trimmed_total"] > 0
+    assert legs["exhaustion"]["stop"]["intent"] == "complete"
+    assert legs["exhaustion"]["degrade"]["intent"] == "degraded"
+    assert legs["exhaustion"]["degrade"]["sigma_tail"] == 0.0
