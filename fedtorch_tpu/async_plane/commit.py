@@ -65,8 +65,10 @@ from fedtorch_tpu.data.streaming import (
     StreamFeedProducer, _cpu_device, _cpu_scope,
 )
 from fedtorch_tpu.models.common import ModelDef
-from fedtorch_tpu.parallel.federated import FederatedTrainer
-from fedtorch_tpu.parallel.mesh import replicate
+from fedtorch_tpu.parallel.federated import (
+    FederatedTrainer, podscale_feed_placer,
+)
+from fedtorch_tpu.parallel.mesh import local_cohort_rows, replicate
 from fedtorch_tpu.parallel.round_program import (
     ASYNC_ALGORITHMS, ASYNC_TRAIN_SALT, CommitJobs,
 )
@@ -268,10 +270,21 @@ class AsyncFederatedTrainer(FederatedTrainer):
         # plan_fn must not close over self (producer-thread leak guard,
         # see FederatedTrainer._next_stream_feed)
         mesh = self.mesh
+        if self.podscale_armed:
+            # pod-scale commit plane: the m-wide buffer is the commit's
+            # cohort — each host packs only its m/S block and the
+            # placer assembles the cohort-sharded device feed (the
+            # CommitJobs extras ride along replicated)
+            place = podscale_feed_placer(mesh, self.buffer_size)
+            cohort_rows = local_cohort_rows(mesh, self.buffer_size,
+                                            self.client_shards)
+        else:
+            place = lambda t: replicate(t, mesh)  # noqa: E731
+            cohort_rows = None
         self._stream = StreamFeedProducer(
             self.host_store, batch_size=self.batch_size,
             start_round=commit0, plan_fn=plan_fn,
-            place_fn=lambda t: replicate(t, mesh))
+            place_fn=place, cohort_rows=cohort_rows)
         self._stream_finalizer = weakref.finalize(
             self, StreamFeedProducer.close, self._stream)
 

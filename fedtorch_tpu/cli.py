@@ -453,6 +453,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "resnet-cifar/cnn + norm=bn, 1-device mesh); "
                         "'auto' currently keeps 'vmap' pending the "
                         "on-chip A/B (docs/performance.md)")
+    p.add_argument("--client_shards", type=int, default=0,
+                   help="pod-scale client-axis sharding: shard the k "
+                        "online clients over this many device groups "
+                        "(power of two <= 64 dividing both the device "
+                        "count and k) with exactly one cross-shard "
+                        "all-reduce at the aggregation seam; 0 = off "
+                        "(legacy program), 1 = the unsharded bitwise "
+                        "twin (docs/performance.md 'Pod-scale round "
+                        "programs')")
     p.add_argument("--allow_train_as_test", type=str2bool, default=False,
                    help="permit dataset loaders with a missing test "
                         "split (EMNIST mirrors) to substitute a slice "
@@ -627,7 +636,8 @@ def args_to_config(args) -> ExperimentConfig:
             num_processes=args.num_processes, process_id=args.process_id,
             compute_dtype=args.compute_dtype,
             scan_unroll=args.scan_unroll, remat=args.remat,
-            client_fusion=args.client_fusion),
+            client_fusion=args.client_fusion,
+            client_shards=args.client_shards),
         telemetry=TelemetryConfig(
             level=args.telemetry,
             cost_capture_scan_rounds=args.cost_capture_scan_rounds,

@@ -713,6 +713,20 @@ class MeshConfig:
     #             not flip defaults ahead of chip data — the conv_impl
     #             lesson (docs/performance.md "Conv-lowering decision").
     client_fusion: str = "auto"
+    # Pod-scale client-axis sharding (docs/performance.md "Pod-scale
+    # round programs"): shard the k online clients of a round over
+    # `client_shards` contiguous device groups — per-shard vmap
+    # execution, on-chip partial sums, exactly ONE cross-shard
+    # all-reduce at the `_round_core` aggregation seam. 0 (default)
+    # keeps the legacy single-shard program byte-identical; 1 arms the
+    # hierarchical aggregation seam on an unsharded cohort (the
+    # bitwise twin every sharded run is pinned against); S > 1 builds
+    # an (S x devices/S) mesh and cuts per-host feed bytes/RAM by S.
+    # Must be a power of two <= 64 that divides both the device count
+    # and the cohort width; illegal compositions (fused execution,
+    # robust rules, cohort stats, ...) are refused by name in
+    # `round_program.validate_cell`.
+    client_shards: int = 0
 
 
 @dataclass(frozen=True)
@@ -854,6 +868,12 @@ class ExperimentConfig:
             raise ValueError(
                 f"mesh.client_fusion must be 'auto', 'vmap' or 'fused', "
                 f"got {self.mesh.client_fusion!r}")
+        cs = self.mesh.client_shards
+        if cs < 0 or cs > 64 or (cs > 0 and cs & (cs - 1)):
+            raise ValueError(
+                "mesh.client_shards must be 0 (off) or a power of two "
+                f"<= 64 (the deterministic aggregation group cap), got "
+                f"{cs}")
         flt = self.fault
         for name in ("client_drop_rate", "straggler_rate",
                      "nan_inject_rate", "byzantine_rate"):

@@ -368,11 +368,25 @@ class MmapClientStore(ClientStore):
         if mm is None:
             shape = ((self._shard_clients(sid) * self.n_max,)
                      + self._feat[tensor])
-            # raises if the file is torn/truncated (mmap length check)
-            # — the producer's 'stream.gather' retry seam owns that
-            mm = np.memmap(str(self._paths[tensor][sid]),
-                           dtype=self._dtypes[tensor], mode="r",
-                           shape=shape)
+            try:
+                # raises if the file is torn/truncated (mmap length
+                # check) — the producer's 'stream.gather' retry seam
+                # owns that, escalating through 'stream.producer'
+                mm = np.memmap(str(self._paths[tensor][sid]),
+                               dtype=self._dtypes[tensor], mode="r",
+                               shape=shape)
+            except (ValueError, OSError) as e:
+                # name the owner: under pod-scale per-host sharded
+                # packing (docs/multihost.md) the recovery chain must
+                # say WHICH host's store shard tore, not just that a
+                # gather failed somewhere in the pod
+                raise ValueError(
+                    f"client-store shard {sid} of tensor {tensor!r} "
+                    f"(owning host: process {jax.process_index()}) is "
+                    "torn or truncated at "
+                    f"{self._paths[tensor][sid]} — expected "
+                    f"{int(np.prod(shape))} x "
+                    f"{self._dtypes[tensor]} elements; {e}") from e
             self._maps[key] = mm
         return mm
 
@@ -642,10 +656,26 @@ class StreamFeedProducer:
                  participation_mode: str = "perm",
                  participation_fn: Optional[Callable] = None,
                  probe_fn: Optional[Callable] = None,
-                 feed_layout: str = "batch"):
+                 feed_layout: str = "batch",
+                 cohort_rows: Optional[Tuple[int, int]] = None):
         self.store = store
         self.start_round = int(start_round)
         self.batch_size = batch_size
+        # pod-scale per-host packing (docs/multihost.md): when the
+        # trainer shards the client axis, this host's producer packs
+        # ONLY cohort rows [lo, hi) — per-host gather work, H2D bytes
+        # and feed RAM shrink by the shard count. idx/sizes stay the
+        # FULL [k] cohort (every shard needs the global weighting /
+        # scatter metadata); only the row tensors are local.
+        if cohort_rows is not None:
+            lo, hi = int(cohort_rows[0]), int(cohort_rows[1])
+            if not 0 <= lo < hi:
+                raise ValueError(
+                    f"cohort_rows must be a [lo, hi) block with "
+                    f"0 <= lo < hi, got {cohort_rows!r}")
+            cohort_rows = (lo, hi)
+        self._cohort_rows = cohort_rows
+        self.shard_pack_s = 0.0  # producer: local-block pack wall
         self._place = place_fn if place_fn is not None else jax.device_put
         self._timeout_s = timeout_s
         self._plan_fn = plan_fn
@@ -701,14 +731,34 @@ class StreamFeedProducer:
         re-draws the injector, and a REAL transient gather error (an
         mmap read hiccup on the disk-backed store) takes the same
         bounded-retry path. Pure over (idx, rows, probe), so retries
-        are exact replays."""
+        are exact replays.
+
+        Under pod-scale sharding (``cohort_rows``) only this host's
+        [lo, hi) client block is gathered; the returned feed's
+        ``idx``/``sizes`` are restored to the full cohort so the
+        device program's weighting and scatter seams see global
+        metadata while x/y/pre_x/pre_y hold k/S rows."""
         def attempt():
             host_chaos.maybe_delay("stream.delay")
             host_chaos.maybe_raise("stream.gather")
-            if rows is None:
-                feed = self.store.pack_shards(idx, self.batch_size)
+            t0 = time.perf_counter()
+            cr = self._cohort_rows
+            if cr is None:
+                pidx, prows = idx, rows
             else:
-                feed = self.store.pack(idx, rows, self.batch_size)
+                pidx = np.asarray(idx)[cr[0]:cr[1]]
+                prows = (None if rows is None
+                         else np.asarray(rows)[cr[0]:cr[1]])
+            if prows is None:
+                feed = self.store.pack_shards(pidx, self.batch_size)
+            else:
+                feed = self.store.pack(pidx, prows, self.batch_size)
+            if cr is not None:
+                full = np.asarray(idx, np.int64)
+                feed = feed._replace(
+                    idx=full.astype(np.int32),
+                    sizes=self.store.sizes[full])
+                self.shard_pack_s += time.perf_counter() - t0
             if probe is not None:
                 qi, qx, qy = self.store.pack_probe(*probe)
                 feed = feed._replace(probe_idx=qi, probe_x=qx,
@@ -723,7 +773,23 @@ class StreamFeedProducer:
         def attempt():
             host_chaos.maybe_delay("stream.delay")
             host_chaos.maybe_raise("stream.gather")
-            feed = self.store.pack_window(idxs, rowss, self.batch_size)
+            t0 = time.perf_counter()
+            cr = self._cohort_rows
+            if cr is None:
+                feed = self.store.pack_window(idxs, rowss,
+                                              self.batch_size)
+            else:
+                # slice the CLIENT axis (axis 1 of [R, k, ...]); the
+                # full [R, k] idx/sizes come back below
+                feed = self.store.pack_window(
+                    np.asarray(idxs)[:, cr[0]:cr[1]],
+                    np.asarray(rowss)[:, cr[0]:cr[1]],
+                    self.batch_size)
+                full = np.asarray(idxs, np.int64)
+                feed = feed._replace(
+                    idx=full.astype(np.int32),
+                    sizes=self.store.sizes[full])
+                self.shard_pack_s += time.perf_counter() - t0
             if probes is not None:
                 packed = [self.store.pack_probe(*p) for p in probes]
                 feed = feed._replace(
@@ -824,7 +890,7 @@ class StreamFeedProducer:
         # stale gauge in a once-per-round telemetry snapshot is
         # harmless — a lock here would serialize the producer's hot
         # loop against the round-row emit for no observable gain
-        return {
+        out = {
             "stream_depth": float(self._prefetcher.depth()),
             "stream_wait_s": self.wait_s,
             "stream_gather_s": self.gather_s,  # lint: disable=FTH003 — GIL-atomic monotone gauges; staleness is bounded by one round
@@ -835,6 +901,14 @@ class StreamFeedProducer:
             "stream_store_mapped_mb":
                 float(self.store.mapped_nbytes) / 1e6,
         }
+        if self._cohort_rows is not None:
+            # pod-scale packing: this host's cohort block width and
+            # its cumulative local pack wall — the per-shard producer
+            # evidence PODSCALE_AB summarizes (docs/performance.md)
+            lo, hi = self._cohort_rows
+            out["stream_shard_rows"] = float(hi - lo)
+            out["stream_shard_pack_s"] = self.shard_pack_s  # lint: disable=FTH003 — GIL-atomic monotone gauge; staleness is bounded by one round
+        return out
 
     def close(self) -> bool:
         """Stop the producer; True when the thread verifiably exited

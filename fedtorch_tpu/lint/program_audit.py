@@ -181,13 +181,28 @@ def check_donation(hlo_text: str, cell: str, donated_leaves: int
         f"aliased={aliased} donated={donated_leaves}")]
 
 
-def check_collectives(hlo_text: str, cell: str, budget: int
-                      ) -> List[Finding]:
-    """FTP004: cross-device collective count vs the cell's budget."""
+def check_collectives(hlo_text: str, cell: str, budget: int, *,
+                      exact: bool = False) -> List[Finding]:
+    """FTP004: cross-device collective count vs the cell's budget.
+
+    ``exact=True`` is the pod-scale certification
+    (``client_shards > 1`` cells): the budget is a floor AND a
+    ceiling — the one explicit client-axis all-reduce of
+    ``podscale.cohort_hierarchical_sum`` must be present (a missing
+    collective means the sharded seam silently fell back to a
+    replicated sum) and nothing may add a second synchronization
+    point."""
     count = 0
     for op in _COLLECTIVE_OPS:
         count += len(re.findall(
             rf"stablehlo\.{op}\b|\b{op.replace('_', '-')}\b", hlo_text))
+    if exact and count < budget:
+        return [_finding(
+            cell, "FTP004",
+            f"{count} collective op(s) under the sharded cell's exact "
+            f"budget of {budget} — the client-axis hierarchical sum's "
+            "explicit all-reduce did not lower (replicated fallback?)",
+            f"collectives={count} budget={budget} exact")]
     if count <= budget:
         return []
     return [_finding(
@@ -195,7 +210,8 @@ def check_collectives(hlo_text: str, cell: str, budget: int
         f"{count} collective op(s) exceed the cell's budget of "
         f"{budget} — a second synchronization point grew into the "
         "round program",
-        f"collectives={count} budget={budget}")]
+        f"collectives={count} budget={budget}"
+        + (" exact" if exact else ""))]
 
 
 def check_large_constants(consts: List[Tuple[str, int]], cell: str
@@ -267,7 +283,8 @@ def save_program_baseline(path: str, findings: List[Finding],
 # -- cell lowering (the only half that imports jax) ----------------------
 
 def _audit_config(source: str, dispatch: str, execution: str,
-                  compute_dtype: str = "float32"):
+                  compute_dtype: str = "float32",
+                  client_shards: int = 0):
     """The tiny canonical audit config for one cell — the same shapes
     the builder-matrix tests pin, built through the cell-enumeration
     hook so cell-to-config mapping cannot drift from the axes."""
@@ -277,7 +294,8 @@ def _audit_config(source: str, dispatch: str, execution: str,
     )
     from fedtorch_tpu.parallel.round_program import cell_build_facts
 
-    facts = cell_build_facts(source, dispatch, execution)
+    facts = cell_build_facts(source, dispatch, execution,
+                             client_shards=client_shards)
     if execution == "fused":
         # the fused execution needs a fused-capable module (cnn/bn on
         # 32x32 inputs) and a single-device mesh
@@ -309,12 +327,14 @@ def _audit_config(source: str, dispatch: str, execution: str,
         optim=OptimConfig(lr=0.3, weight_decay=0.0),
         train=TrainConfig(local_step=2),
         mesh=MeshConfig(client_fusion=facts["client_fusion"],
-                        compute_dtype=compute_dtype),
+                        compute_dtype=compute_dtype,
+                        client_shards=facts["client_shards"]),
     ).finalize()
 
 
 def _build_cell_trainer(source: str, dispatch: str, execution: str,
-                        compute_dtype: str = "float32"):
+                        compute_dtype: str = "float32",
+                        client_shards: int = 0):
     import numpy as np
 
     from fedtorch_tpu.algorithms import make_algorithm
@@ -323,7 +343,8 @@ def _build_cell_trainer(source: str, dispatch: str, execution: str,
     from fedtorch_tpu.models import define_model
     from fedtorch_tpu.parallel import FederatedTrainer
 
-    cfg = _audit_config(source, dispatch, execution, compute_dtype)
+    cfg = _audit_config(source, dispatch, execution, compute_dtype,
+                        client_shards)
     if execution == "fused":
         sizes = (16, 9, 12, 16)
         rng = np.random.RandomState(0)
@@ -345,17 +366,21 @@ def _build_cell_trainer(source: str, dispatch: str, execution: str,
 
 def lower_cell(source: str, dispatch: str, execution: str, *,
                compute_dtype: str = "float32",
-               scan_length: int = AUDIT_SCAN_LENGTH) -> Dict:
+               scan_length: int = AUDIT_SCAN_LENGTH,
+               client_shards: int = 0) -> Dict:
     """Lower one legal cell's uninstrumented twin and return the audit
     evidence: StableHLO text, jaxpr consts, donated-leaf count, and
     the ``jax.stages.Lowered`` (for optional FTP006 compiles).
 
     State comes from ``jax.eval_shape`` over ``init_state`` — no
-    parameter buffer is materialized and nothing executes."""
+    parameter buffer is materialized and nothing executes.
+    ``client_shards > 1`` lowers the cell's pod-scale mesh'd twin
+    (client axis over S device groups) for the FTP004 exact-count
+    certification."""
     import jax
 
     trainer = _build_cell_trainer(source, dispatch, execution,
-                                  compute_dtype)
+                                  compute_dtype, client_shards)
     server, clients = jax.eval_shape(trainer.init_state,
                                      jax.random.key(0))
     if dispatch == "scan":
@@ -381,7 +406,8 @@ def lower_cell(source: str, dispatch: str, execution: str, *,
 
     donated_leaves = len(jax.tree.leaves((server, clients)))
     return {
-        "cell": _cell_label(source, dispatch, execution, compute_dtype),
+        "cell": _cell_label(source, dispatch, execution, compute_dtype,
+                            client_shards),
         "axes": (source, dispatch, execution),
         "program": name,
         "lowered": lowered,
@@ -389,6 +415,7 @@ def lower_cell(source: str, dispatch: str, execution: str, *,
         "consts": consts,
         "donated_leaves": donated_leaves,
         "mesh_devices": int(trainer.mesh.devices.size),
+        "client_shards": int(client_shards),
     }
 
 
@@ -417,11 +444,14 @@ def _const_nbytes(c) -> int:
     return n * itemsize
 
 
-def _cell_label(source, dispatch, execution, compute_dtype) -> str:
+def _cell_label(source, dispatch, execution, compute_dtype,
+                client_shards: int = 0) -> str:
     from fedtorch_tpu.parallel.round_program import cell_name
     label = cell_name(source, dispatch, execution)
     if compute_dtype != "float32":
         label += f"[{compute_dtype}]"
+    if client_shards > 1:
+        label += f"[shards={client_shards}]"
     return label
 
 
@@ -434,15 +464,18 @@ def audit_cell_evidence(ev: Dict, *, compute_dtype: str = "float32",
 
     cell, text = ev["cell"], ev["text"]
     src, disp, exe = ev["axes"]
+    shards = int(ev.get("client_shards", 0))
     budget = collective_budget(src, disp, exe,
                                mesh_devices=ev["mesh_devices"],
-                               num_rounds=num_rounds)
+                               num_rounds=num_rounds,
+                               client_shards=shards)
     findings = []
     findings += check_dtype_promotion(text, cell,
                                       compute_dtype=compute_dtype)
     findings += check_host_transfers(text, cell)
     findings += check_donation(text, cell, ev["donated_leaves"])
-    findings += check_collectives(text, cell, budget)
+    findings += check_collectives(text, cell, budget,
+                                  exact=shards > 1)
     findings += check_large_constants(ev["consts"], cell)
     findings += check_peak_hbm(peak, cell, baseline_peaks or {})
     return findings
@@ -453,6 +486,12 @@ def audit_cell_evidence(ev: Dict, *, compute_dtype: str = "float32",
 # execution pins its own lowering contract in test_client_fusion)
 BF16_CELLS = (("resident", "round", "vmap"), ("feed", "round", "vmap"),
               ("resident", "scan", "vmap"), ("feed", "scan", "vmap"))
+
+# pod-scale twins: every legal vmap cell re-lowers with the client axis
+# sharded this many ways (when the backend has the devices) so FTP004
+# certifies EXACTLY one explicit client-axis all-reduce per
+# round/commit program (docs/performance.md "Pod-scale round programs")
+PODSCALE_SHARDS = 2
 
 
 def audit_programs(*, baseline_path: str = PROGRAM_BASELINE,
@@ -493,13 +532,19 @@ def audit_programs(*, baseline_path: str = PROGRAM_BASELINE,
                                      "refusal": refusal[:200]}
             log(f"audit: {cell}: refused as expected")
             continue
-        variants = [("float32", None)]
+        variants = [("float32", 0)]
         if include_bf16 and (source, dispatch, execution) in BF16_CELLS:
-            variants.append(("bfloat16", None))
-        for compute_dtype, _ in variants:
+            variants.append(("bfloat16", 0))
+        if (execution == "vmap"
+                and len(jax.devices()) >= PODSCALE_SHARDS):
+            # the mesh'd twin of every legal vmap cell — fused cells
+            # refuse multi-shard by name and are not lowered here
+            variants.append(("float32", PODSCALE_SHARDS))
+        for compute_dtype, shards in variants:
             ev = lower_cell(source, dispatch, execution,
                             compute_dtype=compute_dtype,
-                            scan_length=scan_length)
+                            scan_length=scan_length,
+                            client_shards=shards)
             peak = None
             if compile_for_hbm:
                 peak = _compiled_peak(ev["lowered"])
@@ -515,6 +560,7 @@ def audit_programs(*, baseline_path: str = PROGRAM_BASELINE,
                 "hlo_bytes": len(ev["text"]),
                 "donated_leaves": ev["donated_leaves"],
                 "findings": len(cell_findings),
+                **({"client_shards": shards} if shards > 1 else {}),
                 **({"peak_hbm_bytes": peak} if peak is not None else {}),
             }
             log(f"audit: {ev['cell']}: {len(cell_findings)} finding(s)")

@@ -62,6 +62,7 @@ from typing import Callable, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
 
 from fedtorch_tpu.algorithms.base import (FedAlgorithm, num_online_effective)
 from fedtorch_tpu.config import ExperimentConfig
@@ -88,8 +89,12 @@ from fedtorch_tpu.parallel.round_program import (
     RoundProgramBuilder, resolve_gather_mode,
 )
 from fedtorch_tpu.parallel.mesh import (
-    client_sharding, make_mesh, padded_client_count, replicate,
+    client_sharding, cohort_sharding, local_cohort_rows, make_mesh,
+    mesh_client_shards, padded_client_count, replicate,
     replicated_sharding, shard_clients,
+)
+from fedtorch_tpu.parallel.podscale import (
+    cohort_allreduce_bytes, cohort_hierarchical_sum,
 )
 from fedtorch_tpu import telemetry
 from fedtorch_tpu.robustness import host_recovery
@@ -158,6 +163,62 @@ def participation_indices(rng: jax.Array, num_clients: int, k: int,
     has0 = jnp.any(idx == 0)
     force = (round_idx == 0) & ~has0
     return jnp.where(force, idx.at[k - 1].set(0), idx)
+
+
+def podscale_feed_placer(mesh, k: int) -> Callable:
+    """Feed placement for the pod-scale stream plane
+    (docs/performance.md "Pod-scale round programs"): the big cohort
+    tensors (``x``/``y``/``pre_x``/``pre_y``) go up under
+    :func:`cohort_sharding` — on a multi-process mesh each host
+    uploads ONLY its shard's ``[k/S, ...]`` row block (the producer
+    packed nothing else), cut per-host H2D bytes and RAM by the shard
+    count — while the small ``[k]`` vectors and probe batches
+    replicate so the in-program cross-cohort scalars stay
+    single-device-deterministic. Module-level on purpose: the
+    producer thread holds the placer, and a closure over the trainer
+    would keep a dropped trainer (and its jit caches) alive forever.
+
+    Handles flat feeds, ``[R, ...]`` feed windows (detected by
+    ``idx.ndim``), and the async plane's ``(feed, extras)`` pairs."""
+    axis = mesh.axis_names[0]
+    flat_sh = cohort_sharding(mesh)
+    win_sh = NamedSharding(mesh, PartitionSpec(None, axis))
+    rep = replicated_sharding(mesh)
+
+    def put_rep(x):
+        if x is None:
+            return None
+        if rep.is_fully_addressable:
+            return jax.device_put(x, rep)
+        return jax.make_array_from_process_local_data(rep, np.asarray(x))
+
+    def place(item):
+        if isinstance(item, tuple) and not isinstance(item, RoundFeed):
+            feed, extras = item
+            return place(feed), jax.tree.map(put_rep, extras)
+        feed = item
+        win = np.asarray(feed.idx).ndim == 2
+        sh = win_sh if win else flat_sh
+
+        def put_cohort(x):
+            x = np.asarray(x)
+            if sh.is_fully_addressable:
+                return jax.device_put(x, sh)
+            # multi-process: assemble the global cohort axis from this
+            # host's contiguous row block
+            gshape = (x.shape[0], k) + x.shape[2:] if win \
+                else (k,) + x.shape[1:]
+            return jax.make_array_from_process_local_data(sh, x, gshape)
+
+        return RoundFeed(
+            idx=put_rep(feed.idx), sizes=put_rep(feed.sizes),
+            x=put_cohort(feed.x), y=put_cohort(feed.y),
+            pre_x=put_cohort(feed.pre_x), pre_y=put_cohort(feed.pre_y),
+            probe_idx=put_rep(feed.probe_idx),
+            probe_x=put_rep(feed.probe_x),
+            probe_y=put_rep(feed.probe_y))
+
+    return place
 
 
 class FederatedTrainer:
@@ -276,7 +337,9 @@ class FederatedTrainer:
         self.gather_mode = resolve_gather_mode(
             gather_mode, algorithm=algorithm,
             data_plane=self.data_plane, local_steps=self.local_steps,
-            batch_size=self.batch_size, n_max=data.n_max)
+            batch_size=self.batch_size, n_max=data.n_max,
+            client_shards=int(getattr(cfg.mesh, "client_shards", 0)
+                              or 0))
         # train-time flip+crop augmentation for image batches (the
         # reference's cifar transform, prepare_data.py:29-35);
         # ClientData x is [clients, N, H, W, C] for image datasets
@@ -294,6 +357,22 @@ class FederatedTrainer:
         self.mesh = mesh if mesh is not None else make_mesh(
             cfg.mesh, self.num_clients)
         algorithm.mesh_devices = int(self.mesh.devices.size)
+        # pod-scale client-axis sharding (docs/performance.md
+        # "Pod-scale round programs"): client_shards is the EFFECTIVE
+        # shard count S (the 2-D mesh's leading axis; 1 on a legacy
+        # mesh); podscale_armed also covers mesh.client_shards == 1 —
+        # the unsharded twin that runs the same grouped hierarchical
+        # aggregation seam, which every sharded cell is pinned
+        # bitwise against. Disarmed (0, the default) traces the
+        # legacy program byte-identically.
+        self.client_shards = mesh_client_shards(self.mesh)
+        self.podscale_armed = (
+            self.client_shards > 1
+            or int(getattr(cfg.mesh, "client_shards", 0) or 0) >= 1)
+        # static [G, P] bytes the seam's one all-gather moves per
+        # round — stashed at first trace (podscale only), emitted via
+        # telemetry_gauges
+        self._allreduce_bytes: Optional[float] = None
         # client-axis execution strategy (parallel/fusion.py): 'fused'
         # swaps the vmapped per-client model compute for ONE
         # feature_group_count=k grouped conv per layer — k x the MXU
@@ -457,6 +536,13 @@ class FederatedTrainer:
             # what makes the streaming plane's bitwise parity hold.
             rows = jax.vmap(lambda r, s: round_row_plan(
                 r, s, data.x.shape[1], K * B))(rngs, on_sizes)
+            # pod-scale: pin the row plan REPLICATED. The seam's cohort
+            # sharding otherwise propagates backward through the gather
+            # into round_row_plan's argsort, and a cross-device
+            # partitioned sort is not bitwise-stable across shard
+            # counts — the one S-variant lowering in the whole program
+            # (no-op when podscale is disarmed)
+            rows = self._replicate_cohort(rows)
             on_x = data.x[idx[:, None], rows]
             on_y = data.y[idx[:, None], rows]
         else:
@@ -476,6 +562,7 @@ class FederatedTrainer:
                 vrows = jax.vmap(lambda r, s: round_row_plan(
                     r, s, val_data.x.shape[1], K * B,
                     VAL_FOLD))(rngs, on_vsizes)
+                vrows = self._replicate_cohort(vrows)
                 on_vx = val_data.x[idx[:, None], vrows]
                 on_vy = val_data.y[idx[:, None], vrows]
             else:
@@ -519,6 +606,32 @@ class FederatedTrainer:
             rngs, batch_mode=self.gather_mode == "batch",
             val_batch_mode=False,
             probe=feed if feed.probe_idx is not None else None)
+
+    # -- pod-scale cohort layout (mesh.client_shards) ---------------------
+    def _shard_cohort(self, tree):
+        """Constrain ``[k, ...]`` cohort tensors over the client-shard
+        axis (no-op when podscale is disarmed — the legacy program is
+        byte-identical). Per-client compute under the constraint is
+        elementwise-independent across clients, so values are bitwise
+        invariant to the shard count."""
+        if not self.podscale_armed:
+            return tree
+        sh = cohort_sharding(self.mesh)
+        return jax.tree.map(
+            lambda x: jax.lax.with_sharding_constraint(x, sh), tree)
+
+    def _replicate_cohort(self, tree):
+        """Constrain small ``[k]`` cohort vectors replicated (no-op
+        when podscale is disarmed). This is the other half of the
+        bitwise bar: every cross-cohort float reduction outside the
+        hierarchical seam (weight renormalization, metric sums) then
+        lowers to the same single-device reduce at every shard count,
+        so its association can never depend on S."""
+        if not self.podscale_armed:
+            return tree
+        sh = replicated_sharding(self.mesh)
+        return jax.tree.map(
+            lambda x: jax.lax.with_sharding_constraint(x, sh), tree)
 
     def _round_core(self, server: ServerState, clients: ClientState,
                     idx, on_x, on_y, on_vx, on_vy, on_sizes, on_vsizes,
@@ -570,6 +683,15 @@ class FederatedTrainer:
                 base_aux = base_aux["alg"]
         else:
             dp_scale = None
+        # pod-scale cohort layout, pinned BEFORE any cross-client op:
+        # big per-client tensors shard over the client-shard axis (each
+        # shard group executes only its k/S clients' local loops),
+        # small [k] vectors replicate (docstrings above)
+        if self.podscale_armed:
+            on_x, on_y, on_vx, on_vy, pre_x, pre_y = self._shard_cohort(
+                (on_x, on_y, on_vx, on_vy, pre_x, pre_y))
+            idx, on_sizes, on_vsizes = self._replicate_cohort(
+                (idx, on_sizes, on_vsizes))
         cfg, model, alg = self.cfg, self.model, self.algorithm
         K, B, C = self.local_steps, self.batch_size, self.num_clients
         # the online axis length: k_online for the sync planes, the
@@ -583,6 +705,7 @@ class FederatedTrainer:
             # INTO the aggregation weights, so the guard renormalization
             # below redistributes exactly the composed weight
             weights = weights * weight_scale
+        weights = self._replicate_cohort(weights)
 
         # deterministic chaos schedule for this round (crash/straggler/
         # poison masks over the online clients) — its own fold of the
@@ -616,7 +739,7 @@ class FederatedTrainer:
 
         # gather online-client state (the per-round new_group)
         take = lambda t: jax.tree.map(lambda x: jnp.take(x, idx, axis=0), t)
-        on_clients = take(clients)
+        on_clients = self._shard_cohort(take(clients))
 
         # cross-client pre-round hook (APFL adaptive alpha, apfl.py:119-123)
         on_lrs = jax.vmap(lambda e: lr_at(self.schedule, e))(
@@ -785,6 +908,12 @@ class FederatedTrainer:
             )(on_clients, on_x, on_y, on_vx, on_vy,
               on_sizes, on_vsizes, weights, rngs,
               plan.budget_scale, base_p_in, base_a_in)
+        # pod-scale: each shard group leaves the client loops holding
+        # its k/S clients' payloads/state; per-client scalars replicate
+        # so downstream metric sums stay shard-count invariant
+        payloads, deltas, new_on_clients = self._shard_cohort(
+            (payloads, deltas, new_on_clients))
+        losses, accs = self._replicate_cohort((losses, accs))
 
         # wire-level adversaries and faults: the clients' local state
         # stays sane (``deltas`` itself must stay clean: client_post
@@ -841,6 +970,10 @@ class FederatedTrainer:
                                   tree_zeros_like(payloads))
         else:
             accept = None
+        if accept is not None:
+            # the accept mask feeds the renormalization sums below —
+            # replicated, its weighted reductions keep one association
+            accept = self._replicate_cohort(accept)
         if self.avail_sync and flt.byzantine_rate > 0.0:
             # recount attacks that actually reached the server: a
             # cohort member that dropped out or missed the deadline
@@ -887,8 +1020,20 @@ class FederatedTrainer:
                           "susp": rreport.suspicion,
                           "norm_q": cs.norm_q, "disp": cs.dispersion}
         else:
-            payload_sum = jax.tree.map(lambda p: jnp.sum(p, axis=0),
-                                       payloads)
+            if self.podscale_armed:
+                # the pod-scale seam (parallel/podscale.py): the
+                # S-invariant grouped hierarchical sum with exactly
+                # ONE cross-shard all-reduce — robust masks, staleness
+                # weights and the DP stage compose on the reduced
+                # estimate unchanged. S == 1 runs the identical add
+                # chains with no collective (the bitwise twin).
+                payload_sum = cohort_hierarchical_sum(
+                    payloads, self.mesh, self.client_shards)
+                self._allreduce_bytes = cohort_allreduce_bytes(
+                    payloads, k)
+            else:
+                payload_sum = jax.tree.map(
+                    lambda p: jnp.sum(p, axis=0), payloads)
             if accept is not None:
                 # rejected weight redistributed over survivors;
                 # all-rejected rounds contribute a zero payload (server
@@ -955,6 +1100,9 @@ class FederatedTrainer:
             # clients leave the round holding the aggregated server model
             # (model_server = deepcopy(model_client), fedavg.py:97)
             params=jax.vmap(lambda _: new_params)(jnp.arange(k)))
+        # pod-scale: the broadcast params land cohort-sharded so the
+        # [C] scatter below stays a local write per shard group
+        new_on_clients = self._shard_cohort(new_on_clients)
 
         # crash chaos: a crashed client's round never happened on its
         # side — state rolls back to round start, and it reports no
@@ -1385,6 +1533,15 @@ class FederatedTrainer:
             out.update(ss)
         if self.data_plane == "stream":
             out["stream_rebuilds"] = float(self._stream_rebuilds)
+        if self.podscale_armed:
+            # pod-scale gauges (docs/performance.md "Pod-scale round
+            # programs"): the shard count and the static [G, P] bytes
+            # the seam's one all-reduce moves per round (stashed at
+            # trace time; absent until the first round traces)
+            out["client_shards"] = float(self.client_shards)
+            if self._allreduce_bytes is not None:
+                out["cohort_allreduce_bytes"] = float(
+                    self._allreduce_bytes)
         return out
 
     def staleness_histogram(self) -> Optional[dict]:
@@ -1414,6 +1571,16 @@ class FederatedTrainer:
             # a dropped trainer (and its jit caches) alive forever
             mesh = self.mesh
             alg = self.algorithm
+            if self.podscale_armed:
+                # pod-scale stream plane: this host's producer packs
+                # ONLY its shard's cohort rows and the placer
+                # assembles the cohort-sharded global feed
+                place = podscale_feed_placer(mesh, self.k_dispatch)
+                cohort_rows = local_cohort_rows(
+                    mesh, self.k_dispatch, self.client_shards)
+            else:
+                place = lambda t: replicate(t, mesh)
+                cohort_rows = None
             self._stream = StreamFeedProducer(
                 self.host_store, key_data=key_data,
                 key_impl=jax.random.key_impl(server.rng),
@@ -1424,7 +1591,7 @@ class FederatedTrainer:
                 probe_fn=(alg.host_probe_fn(self.host_store.sizes)
                           if alg.needs_post_probe else None),
                 feed_layout=self.gather_mode,
-                place_fn=lambda t: replicate(t, mesh))
+                cohort_rows=cohort_rows, place_fn=place)
             # leak guard: a trainer dropped WITHOUT invalidate_stream
             # must not orphan the producer thread (it would pin the
             # host store + the placed feeds for the process lifetime)
@@ -1556,8 +1723,12 @@ class FederatedTrainer:
         KB = st.n_max if self.gather_mode == "shard" \
             else self.local_steps * self.batch_size
         sh = replicated_sharding(self.mesh)
-        sds = lambda shape, dt: jax.ShapeDtypeStruct(shape, dt,
-                                                     sharding=sh)
+        # pod-scale: the big cohort tensors go up cohort-sharded
+        # (mirroring podscale_feed_placer exactly — the lowered twin
+        # must see the live program's input layout)
+        csh = cohort_sharding(self.mesh) if self.podscale_armed else sh
+        sds = lambda shape, dt, s=sh: jax.ShapeDtypeStruct(
+            shape, dt, sharding=s)
         fx, fy = st.feat("x"), st.feat("y")
         dx, dy = st.dtype("x"), st.dtype("y")
         probe = {}
@@ -1569,19 +1740,24 @@ class FederatedTrainer:
                 probe_y=sds((k2, self.batch_size) + fy, dy))
         return RoundFeed(
             idx=sds((k,), jnp.int32), sizes=sds((k,), st.sizes.dtype),
-            x=sds((k, KB) + fx, dx),
-            y=sds((k, KB) + fy, dy),
-            pre_x=sds((k, self.batch_size) + fx, dx),
-            pre_y=sds((k, self.batch_size) + fy, dy), **probe)
+            x=sds((k, KB) + fx, dx, csh),
+            y=sds((k, KB) + fy, dy, csh),
+            pre_x=sds((k, self.batch_size) + fx, dx, csh),
+            pre_y=sds((k, self.batch_size) + fy, dy, csh), **probe)
 
     def _window_struct(self, num_rounds: int) -> RoundFeed:
         """Abstract twin of a packed ``[R, ...]`` feed window — the
         scanned streamed program's data input (:meth:`_feed_struct`
-        with a leading window axis)."""
-        return jax.tree.map(
-            lambda s: jax.ShapeDtypeStruct(
-                (num_rounds,) + s.shape, s.dtype, sharding=s.sharding),
-            self._feed_struct())
+        with a leading window axis; cohort-sharded fields keep the
+        shard axis on the COHORT dim, not the new window dim)."""
+        def widen(s):
+            sh = s.sharding
+            if isinstance(sh, NamedSharding) and tuple(sh.spec):
+                sh = NamedSharding(sh.mesh,
+                                   PartitionSpec(None, *sh.spec))
+            return jax.ShapeDtypeStruct((num_rounds,) + s.shape,
+                                        s.dtype, sharding=sh)
+        return jax.tree.map(widen, self._feed_struct())
 
     def lowered_cost_programs(self, server, clients,
                               num_scan_rounds: int = 0):

@@ -25,9 +25,14 @@ rest of the composition matrix in ``round_program.validate_cell``):
   own model applies and keep the vmap path;
 * no per-step val batch, no full-data loss phase, no recurrent carry,
   no adversarial-noise param, no MoE aux loss — features the fused
-  forward does not thread;
-* a single-device mesh: the packed channel axis must not be sharded
-  (the vmap path's client-axis sharding is the multi-chip strategy).
+  forward does not thread.
+
+The single-device rule (the packed channel axis must not be sharded;
+the vmap path's client-axis sharding is the multi-chip strategy) is
+NOT here: like commit x fused it is a composition-matrix fact, so
+``round_program.illegal_reason`` owns it — one validator, one named
+refusal, same message for a resolved trainer and for matrix
+enumeration.
 
 ``resolve_client_fusion`` applies the config policy on top: 'vmap'
 and 'fused' are explicit pins ('fused' raises when unsupported —
@@ -70,10 +75,7 @@ def fusion_supported(cfg: ExperimentConfig, model: ModelDef,
         return None, "MoE aux-loss models are not fused"
     if model.is_regression:
         return None, "regression criteria are not fused"
-    if mesh_devices > 1:
-        return None, (f"mesh has {mesh_devices} devices — the packed "
-                      "client/channel axis must not be sharded (use "
-                      "the vmap path's client-axis sharding)")
+    del mesh_devices  # the multi-device refusal is validate_cell's
     fused = define_fused_model(cfg, k_online)
     if fused is None:
         return None, (f"no fused module for arch="

@@ -93,18 +93,43 @@ def init_multihost(cfg: MeshConfig, *,
 
 
 def make_mesh(cfg: MeshConfig, num_clients: Optional[int] = None) -> Mesh:
-    """1-D mesh over all (or the first ``num_devices``) devices.
+    """1-D mesh over all (or the first ``num_devices``) devices — or,
+    with ``cfg.client_shards > 1``, the pod-scale 2-D
+    ``[client_shards, devices/client_shards]`` mesh whose leading axis
+    shards the round's ONLINE COHORT (docs/performance.md "Pod-scale
+    round programs").
 
     Every requested device is always used: when ``num_clients`` does not
     divide the device count, the engine pads the client axis with inert
     zero-weight clients (:func:`padded_client_count`) instead of idling
     chips — SURVEY.md §7's ``[cores, clients_per_core]`` layout. The
     ``num_clients`` argument is kept for API compatibility; it no longer
-    constrains the mesh."""
+    constrains the mesh.
+
+    The 2-D reshape is row-major, so the FLAT device order — and with
+    it the resident ``[C]`` client-state placement under
+    :func:`client_sharding` — is byte-identical for every shard count
+    on the same devices: only the cohort axis re-shards, which is what
+    makes S-shard-vs-1-shard rounds (and degraded-pod resume onto
+    fewer shards) bitwise."""
     del num_clients  # padding, not divisor-clamping, handles remainders
     devices = jax.devices(cfg.backend) if cfg.backend else jax.devices()
     n = cfg.num_devices or len(devices)
     n = min(n, len(devices))
+    shards = max(int(getattr(cfg, "client_shards", 0) or 0), 0)
+    if shards >= 1:
+        # client_shards == 1 still builds the 2-D [1, n] mesh: the
+        # armed 1-shard twin must carry the exact cohort-sharding
+        # structure of its S-shard siblings (cohort axis over a
+        # leading mesh axis of size S) for the bitwise-parity bar
+        if n % shards:
+            raise ValueError(
+                f"mesh.client_shards={shards} does not divide the "
+                f"{n}-device mesh — the cohort shards are contiguous "
+                "device groups, so the device count must be a "
+                "multiple of the shard count")
+        return Mesh(np.asarray(devices[:n]).reshape(shards, n // shards),
+                    (cfg.axis_name, cfg.axis_name + "_rep"))
     return Mesh(np.asarray(devices[:n]), (cfg.axis_name,))
 
 
@@ -121,8 +146,56 @@ def padded_client_count(num_clients: int, mesh: Mesh) -> int:
 
 
 def client_sharding(mesh: Mesh) -> NamedSharding:
-    """Shard the leading client axis over the mesh."""
+    """Shard the leading [C] client axis over ALL mesh axes — on the
+    pod-scale 2-D mesh the row-major flattening reproduces the 1-D
+    device order exactly, so resident client state occupies the same
+    device blocks at every ``client_shards`` setting. A legacy 1-D
+    mesh keeps the single-name spec (not a 1-tuple): the spec objects
+    are semantically equal but not ``==``, and a changed spec on the
+    disarmed path perturbs the jit executable-cache keys the
+    trace-once tests pin."""
+    if len(mesh.axis_names) == 1:
+        return NamedSharding(mesh, P(mesh.axis_names[0]))
+    return NamedSharding(mesh, P(tuple(mesh.axis_names)))
+
+
+def cohort_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard a leading [k] ONLINE-COHORT axis over the client-shard
+    axis only (replicated across the per-shard device group): each of
+    the S contiguous shard groups executes its k/S clients and the
+    aggregation seam's one all-reduce recombines the partials
+    (docs/performance.md "Pod-scale round programs"). On a 1-D mesh
+    this degenerates to :func:`client_sharding`."""
     return NamedSharding(mesh, P(mesh.axis_names[0]))
+
+
+def mesh_client_shards(mesh: Mesh) -> int:
+    """Shard count of the cohort axis: the leading dim of the 2-D
+    pod-scale mesh, 1 on a legacy 1-D mesh."""
+    return int(mesh.devices.shape[0]) if mesh.devices.ndim > 1 else 1
+
+
+def local_cohort_rows(mesh: Mesh, k: int, shards: int):
+    """``[lo, hi)`` cohort rows owned by THIS process's devices under
+    S-way client sharding — the slice its feed producer must pack
+    (per-host H2D bytes and host RAM cut by the shard count). Shards
+    are contiguous row blocks of k/S; a process owning shard rows
+    [s0, s1) owns cohort rows [s0*k/S, s1*k/S). Falls back to the full
+    range for unsharded runs or a non-contiguous device-to-process
+    layout (correct, just not minimal)."""
+    if shards <= 1 or k % shards or mesh.devices.ndim < 2:
+        return 0, k
+    per = k // shards
+    pid = jax.process_index()
+    mine = [s for s in range(shards)
+            if any(d.process_index == pid
+                   for d in np.asarray(mesh.devices)[s].flat)]
+    if not mine:
+        return 0, k
+    lo, hi = min(mine), max(mine) + 1
+    if mine != list(range(lo, hi)):
+        return 0, k
+    return lo * per, hi * per
 
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:

@@ -41,14 +41,29 @@ impossible, each refused by ONE named ``ValueError`` from
   no R-commit program for one trace to scan;
 * algorithm/feature preconditions of an axis value (a ``feed`` source
   cannot replay server-state-dependent participation; ``commit`` needs
-  a stale-snapshot-safe algorithm; ``fused`` needs the base local
-  step on one device) — named with the same reasons the old per-path
-  gates carried. The fused-execution preconditions are authored in
-  ``parallel/fusion.py`` (``fusion_supported``): at trainer
-  construction ``resolve_client_fusion`` raises them directly while
-  resolving the execution axis, and :func:`illegal_reason` consults
-  the same function for matrix enumeration — one rule set, two entry
-  points.
+  a stale-snapshot-safe algorithm; ``fused`` packs the clients into
+  one device's channel axis, so it refuses any multi-device mesh —
+  that rule is authored HERE, not in ``parallel/fusion.py``, because
+  this validator owns the whole composition matrix) — named with the
+  same reasons the old per-path gates carried. The remaining
+  fused-execution preconditions (architecture/normalization/optimizer
+  shape) stay authored in ``parallel/fusion.py``
+  (``fusion_supported``): at trainer construction
+  ``resolve_client_fusion`` raises them directly while resolving the
+  execution axis, and :func:`illegal_reason` consults the same
+  function for matrix enumeration — one rule set, two entry points.
+
+The pod-scale **client-shard fact** (``mesh.client_shards``,
+docs/performance.md "Pod-scale round programs") composes with every
+axis: the round's k online clients split into S contiguous blocks
+over a 2-D ``[S, devices/S]`` mesh, and the aggregation seam reduces
+them with the S-invariant hierarchical sum
+(``parallel/podscale.py``) — exactly ONE cross-shard all-reduce per
+round/commit program, certified by the FTP004 budget. Compositions
+whose cross-client float reductions live OUTSIDE that seam (robust
+rules, cohort statistics, cohort-global-loss algorithms, per-client
+val streams) are refused by name here rather than silently losing
+bitwise parity, and fused × multi-shard stays refused until measured.
 """
 from __future__ import annotations
 
@@ -101,12 +116,15 @@ def iter_cells():
                 yield source, dispatch, execution
 
 
-def cell_build_facts(source: str, dispatch: str, execution: str) -> dict:
+def cell_build_facts(source: str, dispatch: str, execution: str, *,
+                     client_shards: int = 0) -> dict:
     """How a trainer serving this cell is configured — the config
     axes a cell name maps onto. The enumeration hook the program
     auditor (``lint/program_audit.py``) and future matrix drivers
     build trainers from, so cell-to-config mapping lives with the
-    axes instead of being re-derived per caller."""
+    axes instead of being re-derived per caller. ``client_shards``
+    threads the pod-scale cohort-shard fact through unchanged (0 =
+    legacy, S > 1 = the sharded variant of the same cell)."""
     if source not in SOURCES or dispatch not in DISPATCHES \
             or execution not in EXECUTIONS:
         raise ValueError(
@@ -116,11 +134,13 @@ def cell_build_facts(source: str, dispatch: str, execution: str) -> dict:
         "data_plane": "stream" if source == "feed" else "device",
         "sync_mode": "async" if dispatch == "commit" else "sync",
         "client_fusion": execution,
+        "client_shards": client_shards,
     }
 
 
 def collective_budget(source: str, dispatch: str, execution: str, *,
-                      mesh_devices: int, num_rounds: int = 1) -> int:
+                      mesh_devices: int, num_rounds: int = 1,
+                      client_shards: int = 0) -> int:
     """Max cross-device collectives the cell's lowered program may
     carry — the FTP004 budget (``lint/program_audit.py``).
 
@@ -130,7 +150,18 @@ def collective_budget(source: str, dispatch: str, execution: str, *,
     carry none (XLA folds the degenerate collective away). A program
     exceeding this has grown a second synchronization point — the
     exact regression class the one-collective-per-round design
-    exists to prevent."""
+    exists to prevent.
+
+    Under ``client_shards > 1`` the budget is also a FLOOR: the
+    sharded seam stages exactly one explicit client-axis all-gather
+    per round (``parallel/podscale.py``) which appears ONCE textually
+    even inside a scan body, so the auditor certifies the count
+    EXACTLY — a sharded program with zero collectives silently
+    dropped the cross-shard reduction, which is as much a bug as a
+    second sync point. (GSPMD-inserted resharding collectives are
+    post-StableHLO and invisible to the textual count.)"""
+    if client_shards > 1:
+        return 1
     if mesh_devices <= 1:
         return 0
     rounds = num_rounds if dispatch == "scan" else 1
@@ -213,7 +244,81 @@ def illegal_reason(source: str, dispatch: str, execution: str, *, cfg,
             return ("per-client validation splits "
                     "(cfg.federated.personal) are not streamed yet")
 
+    # -- client-shard fact (pod-scale cohort sharding) -------------------
+    shards = int(getattr(cfg.mesh, "client_shards", 0) or 0)
+    if shards > 1:
+        if execution == "fused":
+            return ("client_fusion='fused' packs all k clients into "
+                    "one grouped conv on one device, while "
+                    f"mesh.client_shards={shards} splits the cohort "
+                    "across device groups — fused x multi-shard stays "
+                    "refused until a sharded grouped-conv lowering is "
+                    "measured (use the vmap execution, which shards "
+                    "the client axis)")
+        if k_online % shards:
+            return (f"mesh.client_shards={shards} does not divide the "
+                    f"dispatch cohort width k={k_online} — contiguous "
+                    "k/shards client blocks are the unit of the "
+                    "bitwise hierarchical sum, so the cohort must "
+                    "split evenly (adjust online_client_rate or the "
+                    "shard count)")
+        if cfg.fault.robust_agg != "mean":
+            return (f"robust_agg={cfg.fault.robust_agg!r} reduces "
+                    "across the FULL cohort axis (median/trim "
+                    "selection and norm-bound renormalization are "
+                    "cross-client order-sensitive floats) — only the "
+                    "hierarchical 'mean' seam is certified bitwise "
+                    "under client sharding")
+        if cfg.telemetry.cohort_stats:
+            return ("telemetry.cohort_stats computes cross-cohort "
+                    "dispersion (cosine-to-mean reductions) whose "
+                    "float association is not shard-invariant — "
+                    "disable cohort_stats under "
+                    "mesh.client_shards > 1")
+        alg_name = cfg.effective_algorithm
+        if alg_name not in ASYNC_ALGORITHMS:
+            return (f"algorithm {alg_name!r} is not certified for the "
+                    "sharded aggregation seam: only the FedAvg family "
+                    f"({', '.join(ASYNC_ALGORITHMS)}) confines its "
+                    "cross-client float reductions to the one "
+                    "hierarchical weighted sum (AFL/qFFL aggregate "
+                    "cohort-global losses, DRFA adds a dual phase, "
+                    "and qsparse's tracking variate assumes the "
+                    "round's full payload sum)")
+        if has_val or algorithm.needs_val_batch \
+                or cfg.federated.personal:
+            return ("per-client validation splits "
+                    "(cfg.federated.personal) reduce across the full "
+                    "cohort outside the sharded seam — disable them "
+                    "under mesh.client_shards > 1")
+        if gather_mode == "shard":
+            return ("gather_mode='shard' selects rows in-program via "
+                    "the per-step epoch permutation, and that sort's "
+                    "cross-device partitioning is not bitwise-stable "
+                    "across shard counts — use gather_mode 'auto' or "
+                    "'batch' under mesh.client_shards > 1 (auto "
+                    "resolves 'batch' on an armed mesh)")
+        if dispatch == "commit":
+            conc = cfg.federated.async_concurrency or k_online
+            m = cfg.federated.async_buffer_size or max(1, conc // 2)
+            if m % shards:
+                return ("the async commit buffer width m="
+                        f"{m} does not divide over "
+                        f"mesh.client_shards={shards} — each shard "
+                        "must own whole buffered jobs for the commit "
+                        "program's hierarchical sum (set "
+                        "async_buffer_size to a multiple of the "
+                        "shard count)")
+
     # -- execution axis --------------------------------------------------
+    if execution == "fused" and mesh_devices > 1:
+        # the one multi-device rule of the fused execution, owned by
+        # this validator (not fusion.py) so the whole composition
+        # matrix refuses from a single site
+        return ("mesh.client_fusion='fused' is unsupported: mesh has "
+                f"{mesh_devices} devices — the packed client/channel "
+                "axis must not be sharded (use the vmap path's "
+                "client-axis sharding)")
     if execution == "fused" and dispatch != "commit" \
             and not fused_resolved:
         fused, why = fusion_supported(cfg, model, algorithm,
@@ -465,7 +570,8 @@ class RoundProgramBuilder:
 
 def resolve_gather_mode(gather_mode: str, *, algorithm: FedAlgorithm,
                         data_plane: str, local_steps: int,
-                        batch_size: int, n_max: int) -> str:
+                        batch_size: int, n_max: int,
+                        client_shards: int = 0) -> str:
     """Resolve the explicit gather mode to 'shard' | 'batch'.
 
     'batch' gathers only the K*B rows each online client will touch
@@ -478,8 +584,14 @@ def resolve_gather_mode(gather_mode: str, *, algorithm: FedAlgorithm,
     resolves 'batch' unless the algorithm needs the full loss, since
     the pack already moved exactly the touched rows); 'shard' packs
     whole padded shards and rows are selected in-program, exactly like
-    the device shard gather (qFFL's streamed plan). Refusals ('batch'
-    under a full-loss algorithm) are :func:`validate_cell`'s, not this
+    the device shard gather (qFFL's streamed plan). On an armed
+    pod-scale mesh (``client_shards >= 1``) auto never picks 'shard'
+    by the K*B revisit heuristic: the shard plan's per-step epoch
+    permutation is the partitioned-sort hazard ``validate_cell``
+    refuses under ``client_shards > 1``, and the armed 1-shard twin
+    must resolve identically to its sharded siblings. Refusals
+    ('batch' under a full-loss algorithm, explicit 'shard' under
+    cohort sharding) are :func:`validate_cell`'s, not this
     function's."""
     if gather_mode not in ("auto", "shard", "batch"):
         raise ValueError(f"unknown gather_mode {gather_mode!r}")
@@ -487,6 +599,7 @@ def resolve_gather_mode(gather_mode: str, *, algorithm: FedAlgorithm,
         return "shard" if algorithm.needs_full_loss else "batch"
     if gather_mode == "auto":
         return "shard" if (algorithm.needs_full_loss
-                           or local_steps * batch_size >= n_max) \
+                           or (client_shards < 1
+                               and local_steps * batch_size >= n_max)) \
             else "batch"
     return gather_mode

@@ -80,25 +80,14 @@ def _log_comb(n: int, k: int) -> float:
             - math.lgamma(n - k + 1))
 
 
-def subsampled_gaussian_rdp(q: float, noise_multiplier: float,
-                            order: float) -> float:
-    """RDP(alpha) of one Poisson-subsampled Gaussian release at
-    sampling probability ``q`` (Mironov et al. 2019, Thm 11 binomial
-    form), evaluated via logsumexp in f64:
+def _integer_subsampled_rdp(q: float, noise_multiplier: float,
+                            alpha: int) -> float:
+    """The Mironov et al. 2019 Thm 11 binomial closed form at INTEGER
+    alpha >= 2, evaluated via logsumexp in f64:
 
         RDP(alpha) = log( sum_{j=0}^{alpha} C(alpha, j) (1-q)^{alpha-j}
                           q^j exp(j (j-1) / (2 z^2)) ) / (alpha - 1)
-
-    The closed form holds at INTEGER alpha >= 2; a fractional order is
-    charged at ``ceil(alpha)`` — RDP is nondecreasing in alpha, so the
-    integer evaluation upper-bounds the fractional charge and the
-    accountant stays a valid (slightly conservative) upper bound.
-    ``q >= 1`` falls back to the exact un-subsampled Gaussian RDP."""
-    if q <= 0.0:
-        return 0.0
-    if q >= 1.0:
-        return gaussian_rdp(noise_multiplier, order)
-    alpha = max(int(math.ceil(order)), 2)
+    """
     z2 = float(noise_multiplier) ** 2
     log_q, log_1mq = math.log(q), math.log1p(-q)
     log_terms = [
@@ -108,6 +97,47 @@ def subsampled_gaussian_rdp(q: float, noise_multiplier: float,
     m = max(log_terms)
     lse = m + math.log(sum(math.exp(t - m) for t in log_terms))
     return lse / (alpha - 1.0)
+
+
+def subsampled_gaussian_rdp(q: float, noise_multiplier: float,
+                            order: float) -> float:
+    """RDP(alpha) of one Poisson-subsampled Gaussian release at
+    sampling probability ``q`` (:func:`_integer_subsampled_rdp`'s
+    binomial closed form at integer alpha).
+
+    The closed form holds at INTEGER alpha >= 2. A fractional order
+    is charged by CONVEXITY OF THE CGF rather than rounding up: the
+    moment-generating function ``cgf(alpha) = (alpha-1) RDP(alpha)``
+    is convex in alpha (it is a log of a moment, Van Erven & Harremoes
+    2014), and ``cgf(1) = 0`` exactly, so with ``n = floor(alpha)``
+    and ``t = alpha - n``:
+
+        cgf(alpha) <= (1-t) cgf(n) + t cgf(n+1)
+        RDP(alpha) <= [(1-t) cgf(n) + t cgf(n+1)] / (alpha - 1)
+
+    — still a valid upper bound, but strictly tighter than the old
+    ``ceil(alpha)`` charge whenever ``n >= 2`` (the chord lies below
+    ``cgf(n+1)``; at ``n = 1`` the ``cgf(1) = 0`` anchor makes the
+    chord reproduce the RDP(2) charge exactly). The tightening is
+    what lets the dense fractional head of :data:`DEFAULT_ORDERS`
+    actually land the conversion optimum between integers instead of
+    snapping to it. ``q >= 1`` falls back to the exact un-subsampled
+    Gaussian RDP, which holds at every real alpha > 1."""
+    if q <= 0.0:
+        return 0.0
+    if q >= 1.0:
+        return gaussian_rdp(noise_multiplier, order)
+    n = int(math.floor(order))
+    if n >= 2 and float(n) == float(order):
+        return _integer_subsampled_rdp(q, noise_multiplier, n)
+    n = max(n, 1)
+    t = float(order) - n
+
+    def cgf(a: int) -> float:
+        return 0.0 if a <= 1 else \
+            (a - 1.0) * _integer_subsampled_rdp(q, noise_multiplier, a)
+
+    return ((1.0 - t) * cgf(n) + t * cgf(n + 1)) / (float(order) - 1.0)
 
 
 def rdp_to_epsilon(orders: Sequence[float], rdp: Sequence[float],

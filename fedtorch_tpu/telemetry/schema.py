@@ -84,6 +84,19 @@ METRICS_OPTIONAL = {
                                 "(mmap store: sizes vector only)",
     "stream_store_mapped_mb": "client-store bytes memory-mapped from "
                               "disk (0 for the RAM store)",
+    # pod-scale client-axis sharding (parallel/podscale.py;
+    # docs/performance.md "Pod-scale round programs") — present only
+    # when mesh.client_shards arms the sharded seam
+    "client_shards": "client-axis shard count S of the armed mesh "
+                     "(the round's cohort is split S ways)",
+    "cohort_allreduce_bytes": "static [G, P] partial-sum bytes the "
+                              "seam's ONE cross-shard all-reduce "
+                              "moves per round (stashed at trace "
+                              "time)",
+    "stream_shard_rows": "cohort rows THIS host's producer packed "
+                         "(its owned shard slices; k/S per shard)",
+    "stream_shard_pack_s": "producer wall spent packing this host's "
+                           "shard rows (per-host scaling gauge)",
     # round-wall critical path (telemetry/critical_path.py;
     # docs/observability.md "Operating and comparing runs")
     "overlap_efficiency": "fraction of this round's producer "

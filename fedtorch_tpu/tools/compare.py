@@ -132,7 +132,9 @@ def compare_runs(dir_a: str, dir_b: str) -> Dict:
                 "ckpt_queue_depth",
                 "async_commit_rate", "async_dropouts",
                 "cohort_dispersion", "avail_dropped", "deadline_missed",
-                "quorum_degraded"):
+                "quorum_degraded",
+                "client_shards", "cohort_allreduce_bytes",
+                "stream_shard_pack_s", "stream_shard_rows"):
         add(f"gauge.{key}", _mean_gauge(rows_a, key),
             _mean_gauge(rows_b, key))
     ov_a, ov_b = sum_a.get("overlap"), sum_b.get("overlap")

@@ -302,7 +302,8 @@ def summarize(run_dir: str, run: Optional[Dict] = None) -> Dict:
                 or key in ("overlap_efficiency", "round_device_min_s",
                            "round_host_frac",
                            "model_flops_utilization",
-                           "hbm_program_peak_bytes", "hbm_live_bytes"):
+                           "hbm_program_peak_bytes", "hbm_live_bytes",
+                           "client_shards"):
             s["last_gauges"][key] = last[key]
     return s
 

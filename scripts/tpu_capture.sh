@@ -28,6 +28,17 @@
 #                         -> MILLION_CLIENT_COMPARE.json
 #                         (docs/performance.md "The million-client
 #                         store")
+#   podscale         scripts/podscale_bench.py  -> PODSCALE_AB.json
+#                        (shard sweep: rounds/sec + clients/sec vs
+#                         mesh.client_shards, bitwise parity vs the
+#                         1-shard twin, 0 retraces) + the
+#                         artifacts/podscale_northstar run dir, gated
+#                         against the previous window's rotated copy
+#                         by tests/data/ops_runs/podscale_gates.json
+#                         -> PODSCALE_COMPARE.json; regressed
+#                         clients/sec exits nonzero
+#                         (docs/performance.md "Pod-scale round
+#                         programs")
 #   async            scripts/async_bench.py       -> ASYNC_AB.json
 #                        (sync round clock vs FedBuff-style commit
 #                         clock under the straggler-heavy schedule +
@@ -151,7 +162,8 @@ TRIES="${TPU_CAPTURE_WAIT_TRIES:-90}"   # ~6 h of patience by default
 # the relay wedges mid-list
 # audit rides early: it is seconds of abstract lowering and proves the
 # program invariants on the real backend before the long benches run
-DEFAULT_STEPS="audit concurrency mfu stream population builder-matrix avail \
+DEFAULT_STEPS="audit concurrency mfu stream population podscale \
+builder-matrix avail \
 privacy async attack host-chaos cohort telemetry compare bench-streaming \
 bench-dispatch bench-unroll bench zoo pallas flash-train vmap baseline"
 STEPS="${*:-$DEFAULT_STEPS}"
@@ -178,6 +190,39 @@ for step in $STEPS; do
                             artifacts/population_ab/b \
                             --gate tests/data/ops_runs/population_gates.json \
                             --out MILLION_CLIENT_COMPARE.json ;;
+        podscale)       # pod-scale shard sweep (ISSUE 20): rounds/sec
+                        # + clients/sec vs client_shards, then gate the
+                        # fresh largest-shard window against the
+                        # previous one (same freshness-guard + rotate
+                        # idiom as the telemetry compare step: a run
+                        # dir not newer than _prev means the bench
+                        # failed this window — skip the scaling gate
+                        # rather than diff stale data against itself)
+                        run python scripts/podscale_bench.py
+                        if [ -d artifacts/podscale_northstar_prev ] \
+                            && [ ! artifacts/podscale_northstar/metrics.jsonl \
+                                 -nt artifacts/podscale_northstar_prev/metrics.jsonl ]; then
+                            echo "[tpu_capture] podscale: capture is not" \
+                                "newer than _prev (bench skipped/failed" \
+                                "this window?) — skipping scaling gate"
+                            FAILED=1
+                        else
+                            if [ -d artifacts/podscale_northstar_prev ]; then
+                                run python -m fedtorch_tpu.tools.compare \
+                                    artifacts/podscale_northstar_prev \
+                                    artifacts/podscale_northstar \
+                                    --gate tests/data/ops_runs/podscale_gates.json \
+                                    --out PODSCALE_COMPARE.json
+                            else
+                                echo "[tpu_capture] podscale: no previous" \
+                                    "capture — recording baseline only"
+                            fi
+                            if [ -d artifacts/podscale_northstar ]; then
+                                rm -rf artifacts/podscale_northstar_prev
+                                cp -r artifacts/podscale_northstar \
+                                    artifacts/podscale_northstar_prev
+                            fi
+                        fi ;;
         async)          run python scripts/async_bench.py ;;
         attack)         run python scripts/chaos_suite.py \
                             --attack-matrix --rounds 25 \

@@ -1,0 +1,54 @@
+"""Slow-lane smoke for the pod-scale shard-sweep bench
+(scripts/podscale_bench.py → PODSCALE_AB.json): the capture must run
+end to end on the forced 8-device CPU mesh, report bitwise parity
+against the 1-shard twin at every shard count, zero timed-window
+retraces, and a compare-able run dir — so the on-chip capture
+(tpu_capture.sh `podscale` step) cannot be the first time the script
+ever executes."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_podscale_bench_smoke(tmp_path):
+    out_path = str(tmp_path / "PODSCALE_AB.json")
+    runs_dir = str(tmp_path / "podscale_northstar")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PODSCALE_BENCH_SMOKE="1", PODSCALE_AB_PATH=out_path,
+               PODSCALE_RUNS_DIR=runs_dir)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "podscale_bench.py")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    with open(out_path) as f:
+        report = json.load(f)
+    # the forced 8-device mesh admits the whole smoke sweep
+    assert report["config"]["shard_sweep"] == [1, 2, 4]
+    assert set(report["shards"]) == {"1", "2", "4"}
+    for s, arm in report["shards"].items():
+        # the hard bars, per arm: bitwise vs the 1-shard twin and
+        # trace-once (the timed window is retrace-free)
+        assert arm["parity_bitwise_vs_one_shard"] is True, s
+        assert arm["retraces_during_timed_rounds"] == 0, s
+        assert arm["ms_per_round"] > 0
+        assert arm["clients_per_s"] == pytest.approx(
+            arm["k_dispatch"] * arm["rounds_per_s"])
+    # sharded arms moved the seam's one all-reduce
+    assert report["shards"]["2"]["cohort_allreduce_bytes"] > 0
+    assert report["ok"] is True
+    # the compare-able artifact: metrics/v1 header + per-round rows
+    # carrying the pod-scale gauges the scaling gate reads
+    with open(os.path.join(runs_dir, "metrics.jsonl")) as f:
+        lines = [json.loads(ln) for ln in f]
+    assert lines[0]["schema"] == "fedtorch_tpu.metrics/v1"
+    assert lines[0]["run"]["client_shards"] == 4
+    for row in lines[1:]:
+        assert row["client_shards"] == 4.0
+        assert row["cohort_allreduce_bytes"] > 0

@@ -124,6 +124,60 @@ class TestAccountant:
         assert subsampled_gaussian_rdp(0.3, 1.0, 8.0) < \
             gaussian_rdp(1.0, 8.0)
 
+    def test_fractional_orders_cgf_interpolation(self):
+        """Fractional alpha is charged by CGF-convexity interpolation,
+        not rounded up: still a valid upper bound (>= the exact value
+        is untestable directly, so the pins are monotonicity in alpha
+        plus never-worse-than-ceil), strictly tighter than the old
+        ceil(alpha) charge for floor(alpha) >= 2, exact at integer
+        alpha, and the (1, 2) anchor reproduces the RDP(2) charge."""
+        from fedtorch_tpu.robustness.privacy import (
+            DEFAULT_ORDERS, _integer_subsampled_rdp,
+        )
+        q, z = 0.02, 1.1
+        # monotone over the whole default grid (RDP is nondecreasing
+        # in alpha; the chord interpolation must preserve that)
+        grid = [subsampled_gaussian_rdp(q, z, a)
+                for a in sorted(DEFAULT_ORDERS)]
+        assert all(b >= a - 1e-15 for a, b in zip(grid, grid[1:]))
+        for alpha in (2.5, 3.25, 5.75, 10.5, 40.125):
+            new = subsampled_gaussian_rdp(q, z, alpha)
+            ceil_charge = _integer_subsampled_rdp(
+                q, z, int(math.ceil(alpha)))
+            assert new < ceil_charge  # strictly tighter, n >= 2
+        for alpha in (2, 3, 7, 32):  # integers: the closed form itself
+            assert subsampled_gaussian_rdp(q, z, float(alpha)) == \
+                _integer_subsampled_rdp(q, z, alpha)
+        # cgf(1) = 0 anchor: every order in (1, 2) charges RDP(2)
+        r2 = _integer_subsampled_rdp(q, z, 2)
+        for alpha in (1.125, 1.5, 1.875):
+            assert abs(subsampled_gaussian_rdp(q, z, alpha) - r2) \
+                < 1e-12 * max(r2, 1.0)
+
+    def test_fractional_tightening_keeps_closed_form_bar(self):
+        """The tightened fractional charge must not push the
+        subsampled accountant ABOVE the old ceil-based epsilon (it can
+        only lower the grid minimum), and the q=1 control stays on the
+        existing 1% closed-form bar."""
+        from fedtorch_tpu.robustness.privacy import (
+            DEFAULT_ORDERS, _integer_subsampled_rdp, rdp_to_epsilon,
+        )
+        q, z, T = 0.1, 1.0, 200
+        acc = PrivacyAccountant(z, DELTA)
+        acc.charge(q, rounds=T)
+        old_rdp = [T * (_integer_subsampled_rdp(q, z,
+                                                max(int(math.ceil(a)),
+                                                    2))
+                        if 0.0 < q < 1.0 else gaussian_rdp(z, a))
+                   for a in acc.orders]
+        old_eps = rdp_to_epsilon(acc.orders, old_rdp, DELTA)
+        assert acc.epsilon() <= old_eps * (1.0 + 1e-12)
+        # q=1 control: the grid optimum still within 1% of closed form
+        acc1 = PrivacyAccountant(z, DELTA)
+        acc1.charge(1.0, rounds=T)
+        cf = closed_form_epsilon(z, T, DELTA)
+        assert abs(acc1.epsilon() - cf) / cf < 0.01
+
     def test_epsilon_zero_before_any_charge(self):
         assert PrivacyAccountant(1.0, DELTA).epsilon() == 0.0
 

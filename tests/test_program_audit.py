@@ -163,8 +163,11 @@ class TestFullMatrix:
         legal = {c: r for c, r in report["cells"].items() if r["legal"]}
         refused = {c: r for c, r in report["cells"].items()
                    if not r["legal"]}
-        # 10 legal cells (+ bf16 twins of the vmap round/scan cells)
-        assert len([c for c in legal if "[bfloat16]" not in c]) == 10
+        # 10 legal cells + the 6 [shards=2] pod-scale twins of the
+        # vmap cells (+ bf16 twins of the vmap round/scan cells)
+        assert len([c for c in legal if "[bfloat16]" not in c
+                    and "[shards=" not in c]) == 10
+        assert len([c for c in legal if "[shards=" in c]) == 6
         assert len([c for c in legal if "[bfloat16]" in c]) == 4
         assert set(refused) == {"(resident x commit x fused)",
                                 "(feed x commit x fused)"}
